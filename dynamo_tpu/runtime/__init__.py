@@ -1,0 +1,23 @@
+"""Distributed runtime substrate (host side).
+
+Plays the role of the reference's ``lib/runtime`` crate: discovery + leases
+(our own lease-KV store instead of etcd), request transport + response streams
+(direct TCP with a two-part codec instead of NATS+TCP), the
+Namespace/Component/Endpoint/Instance model, the AsyncEngine pipeline
+abstraction with cancellation contexts, and the leader/worker barrier
+(ref: lib/runtime/src/{lib.rs,component.rs,engine.rs,
+utils/leader_worker_barrier.rs}).
+"""
+
+from .component import DistributedRuntime, Namespace, Component, Endpoint
+from .context import Context
+from .engine import AsyncEngine
+
+__all__ = [
+    "DistributedRuntime",
+    "Namespace",
+    "Component",
+    "Endpoint",
+    "Context",
+    "AsyncEngine",
+]

@@ -1,0 +1,307 @@
+"""Namespace → Component → Endpoint → Instance model over the discovery store.
+
+The cluster addressing scheme (ref: lib/runtime/src/component.rs:75-143):
+instances register under
+``v1/instances/{namespace}/{component}/{endpoint}/{instance_id}`` with their
+TCP ingress address, attached to the process's primary lease so worker death
+deregisters them automatically. ``Client`` watches that prefix and keeps a
+live instance list for routing (ref: component/client.rs:285).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import AsyncIterator, Callable, Dict, List, Optional
+
+import msgpack
+
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+from .context import Context
+from .engine import AsyncEngine, FnEngine
+from .store import StoreClient
+from .transport import EngineError, ERR_UNAVAILABLE, IngressServer, TransportClient
+
+log = get_logger("component")
+
+INSTANCE_ROOT = "v1/instances/"
+MODEL_ROOT = "v1/models/"     # ref: kv_router.rs:36 MODEL_ROOT_PATH
+MDC_ROOT = "v1/mdc/"          # model deployment cards
+BARRIER_ROOT = "v1/barrier/"
+
+
+@dataclass(frozen=True)
+class Instance:
+    instance_id: int
+    namespace: str
+    component: str
+    endpoint: str
+    addr: str  # host:port of the worker's TCP ingress
+
+    @property
+    def key(self) -> str:
+        return (
+            f"{INSTANCE_ROOT}{self.namespace}/{self.component}/"
+            f"{self.endpoint}/{self.instance_id}"
+        )
+
+
+class DistributedRuntime:
+    """Process-local handle on the cluster (ref: lib/runtime/src/lib.rs:145).
+
+    Owns the store client (with primary lease + keepalive), the transport
+    client pool, the metrics root, and the shutdown event. Lease loss triggers
+    runtime shutdown, matching the reference's liveness contract.
+    """
+
+    def __init__(self, store: StoreClient, config: RuntimeConfig):
+        self.store = store
+        self.config = config
+        self.transport = TransportClient()
+        self.metrics = MetricsRegistry(prefix="dynamo")
+        self.shutdown_event = asyncio.Event()
+        self._ingress_servers: List[IngressServer] = []
+        store.on_lease_lost = self._on_lease_lost
+
+    @staticmethod
+    async def from_settings(
+        config: Optional[RuntimeConfig] = None,
+    ) -> "DistributedRuntime":
+        config = config or RuntimeConfig.from_settings()
+        store = await StoreClient.connect(
+            config.store_addr, lease_ttl_s=config.lease_ttl_s
+        )
+        return DistributedRuntime(store, config)
+
+    def _on_lease_lost(self) -> None:
+        log.error("primary lease lost — shutting down runtime")
+        self.shutdown_event.set()
+
+    @property
+    def primary_lease(self) -> int:
+        return self.store.primary_lease
+
+    def namespace(self, name: Optional[str] = None) -> "Namespace":
+        return Namespace(self, name or self.config.namespace)
+
+    async def shutdown(self) -> None:
+        self.shutdown_event.set()
+        for srv in self._ingress_servers:
+            await srv.stop()
+        await self.transport.close()
+        await self.store.close()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+        self.metrics = runtime.metrics.child(namespace=name)
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        self.namespace = namespace
+        self.name = name
+        self.runtime = namespace.runtime
+        self.metrics = namespace.metrics.child(component=name)
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace.name}/{self.name}"
+
+    def event_subject(self, name: str) -> str:
+        """Store key prefix used as a pub/sub subject for this component
+        (e.g. ``kv_events``, ref: kv_router.rs:60)."""
+        return f"v1/events/{self.path}/{name}/"
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+        self.runtime = component.runtime
+        self.metrics = component.metrics.child(endpoint=name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.path}/{self.name}"
+
+    @property
+    def instance_prefix(self) -> str:
+        return f"{INSTANCE_ROOT}{self.path}/"
+
+    async def serve_endpoint(
+        self,
+        handler: AsyncEngine | Callable,
+        *,
+        host: str = "0.0.0.0",
+        advertise_host: str = "127.0.0.1",
+        port: int = 0,
+        max_inflight: Optional[int] = None,
+        metadata: Optional[dict] = None,
+    ) -> "ServedEndpoint":
+        """Start a TCP ingress for ``handler`` and register the instance
+        (ref: bindings _core.pyi:216 ``serve_endpoint``)."""
+        engine = handler if isinstance(handler, AsyncEngine) else FnEngine(handler)
+        server = IngressServer(engine, host=host, port=port, max_inflight=max_inflight)
+        await server.start()
+        self.runtime._ingress_servers.append(server)
+        instance = Instance(
+            instance_id=self.runtime.primary_lease,
+            namespace=self.component.namespace.name,
+            component=self.component.name,
+            endpoint=self.name,
+            addr=f"{advertise_host}:{server.port}",
+        )
+        record = {
+            "instance_id": instance.instance_id,
+            "addr": instance.addr,
+            "transport": "tcp",
+            "metadata": metadata or {},
+        }
+        await self.runtime.store.put(
+            instance.key,
+            msgpack.packb(record, use_bin_type=True),
+            lease=self.runtime.primary_lease,
+        )
+        log.info("serving %s as instance %d at %s",
+                 self.path, instance.instance_id, instance.addr)
+        return ServedEndpoint(self, server, instance)
+
+    async def client(self) -> "Client":
+        client = Client(self)
+        await client.start()
+        return client
+
+
+class ServedEndpoint:
+    def __init__(self, endpoint: Endpoint, server: IngressServer, instance: Instance):
+        self.endpoint = endpoint
+        self.server = server
+        self.instance = instance
+
+    async def drain_and_stop(self) -> None:
+        """Graceful shutdown: deregister, stop accepting, drain in-flight."""
+        self.server.draining = True
+        await self.endpoint.runtime.store.delete(self.instance.key)
+        await self.server.join()
+        await self.server.stop()
+
+    async def stop(self) -> None:
+        await self.endpoint.runtime.store.delete(self.instance.key)
+        await self.server.stop()
+
+
+class Client:
+    """Watches an endpoint's instance prefix; routes requests to instances
+    (ref: component/client.rs:285 + pipeline/network/egress/push_router.rs)."""
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self.runtime = endpoint.runtime
+        self.instances: Dict[int, Instance] = {}
+        self._rr = 0
+        self._watch_task: Optional[asyncio.Task] = None
+        self._instances_changed = asyncio.Event()
+        self.on_instance_removed: List[Callable[[int], None]] = []
+        self.on_instance_added: List[Callable[[int], None]] = []
+
+    async def start(self) -> None:
+        snapshot, stream = await self.runtime.store.watch_prefix(
+            self.endpoint.instance_prefix
+        )
+        for key, value in snapshot:
+            self._apply("put", key, value)
+        self._watch_task = asyncio.create_task(self._watch_loop(stream))
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+
+    def _apply(self, event: str, key: str, value: Optional[bytes]) -> None:
+        instance_id = int(key.rsplit("/", 1)[1])
+        if event == "put" and value is not None:
+            record = msgpack.unpackb(value, raw=False)
+            self.instances[instance_id] = Instance(
+                instance_id=instance_id,
+                namespace=self.endpoint.component.namespace.name,
+                component=self.endpoint.component.name,
+                endpoint=self.endpoint.name,
+                addr=record["addr"],
+            )
+            for cb in self.on_instance_added:
+                cb(instance_id)
+        elif event == "delete":
+            if self.instances.pop(instance_id, None) is not None:
+                for cb in self.on_instance_removed:
+                    cb(instance_id)
+        self._instances_changed.set()
+        self._instances_changed = asyncio.Event()
+
+    async def _watch_loop(self, stream) -> None:
+        async for event in stream:
+            self._apply(event["event"], event["key"], event.get("value"))
+
+    def instance_ids(self) -> List[int]:
+        return sorted(self.instances.keys())
+
+    async def wait_for_instances(self, n: int = 1, timeout_s: float = 60.0) -> None:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while len(self.instances) < n:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{self.endpoint.path}: {len(self.instances)}/{n} instances"
+                )
+            event = self._instances_changed
+            try:
+                await asyncio.wait_for(asyncio.shield(event.wait()), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- request push (ref: push_router.rs RouterMode Direct/Random/RoundRobin) --
+
+    def _pick(self, mode: str) -> Instance:
+        ids = self.instance_ids()
+        if not ids:
+            raise EngineError(
+                f"no instances for {self.endpoint.path}", ERR_UNAVAILABLE
+            )
+        if mode == "random":
+            chosen = random.choice(ids)
+        else:  # round_robin
+            chosen = ids[self._rr % len(ids)]
+            self._rr += 1
+        return self.instances[chosen]
+
+    def direct(
+        self, instance_id: int, request: object, context: Context
+    ) -> AsyncIterator[object]:
+        instance = self.instances.get(instance_id)
+        if instance is None:
+            raise EngineError(
+                f"instance {instance_id} not found for {self.endpoint.path}",
+                ERR_UNAVAILABLE,
+            )
+        return self.runtime.transport.generate(instance.addr, request, context)
+
+    def round_robin(self, request: object, context: Context) -> AsyncIterator[object]:
+        return self.runtime.transport.generate(
+            self._pick("round_robin").addr, request, context
+        )
+
+    def random(self, request: object, context: Context) -> AsyncIterator[object]:
+        return self.runtime.transport.generate(
+            self._pick("random").addr, request, context
+        )

@@ -1,0 +1,66 @@
+"""Request contexts: identity, distributed trace, hierarchical cancellation.
+
+Equivalent to the reference's ``AsyncEngineContext`` (ref: lib/runtime/src/
+engine.rs:112): every in-flight request carries an id, a trace context, and
+two cancellation levels — ``stop_generating`` (graceful: finish the current
+token, emit what we have) and ``kill`` (abandon the stream). Contexts form a
+tree via ``link_child`` so cancelling upstream propagates downstream
+(ref: docs/architecture/request_cancellation.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import List, Optional
+
+from ..utils.logging import TraceContext
+
+
+class Context:
+    def __init__(
+        self,
+        request_id: Optional[str] = None,
+        trace: Optional[TraceContext] = None,
+    ):
+        self.id: str = request_id or uuid.uuid4().hex
+        self.trace: TraceContext = trace or TraceContext.new()
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+        self._children: List["Context"] = []
+
+    # -- cancellation tree --
+
+    def link_child(self, child: "Context") -> "Context":
+        self._children.append(child)
+        if self.is_stopped():
+            child.stop_generating()
+        if self.is_killed():
+            child.kill()
+        return child
+
+    def child(self) -> "Context":
+        return self.link_child(Context(request_id=self.id, trace=self.trace.child()))
+
+    def stop_generating(self) -> None:
+        self._stopped.set()
+        for c in self._children:
+            c.stop_generating()
+
+    def kill(self) -> None:
+        self._killed.set()
+        self._stopped.set()
+        for c in self._children:
+            c.kill()
+
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+    async def wait_killed(self) -> None:
+        await self._killed.wait()

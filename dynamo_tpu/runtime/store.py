@@ -1,0 +1,770 @@
+"""Lease-KV discovery store: the control-plane brain of the cluster.
+
+Plays the role etcd plays in the reference (ref: lib/runtime/src/transports/
+etcd.rs:35-324): a small TCP service holding a revisioned key-value map with
+
+- **leases**: TTL'd handles with keepalive; when a lease dies every key
+  attached to it is deleted and watchers are notified — this is the liveness
+  mechanism (worker death ⇒ its ``instances/…`` and ``models/…`` keys vanish,
+  ref: etcd.rs:89-95),
+- **watches**: prefix subscriptions that stream put/delete events,
+- **atomic create** (fails if key exists) and compare-and-swap,
+- **distributed locks** built on atomic create + leases (ref: etcd.rs:300),
+- **barriers** for leader/worker rendezvous (via ``wait_for_key_count``,
+  ref: utils/leader_worker_barrier.rs:24).
+
+It also carries the two roles NATS plays in the reference:
+
+- **pub/sub subjects** (no storage, fan-out to live subscribers) for KV
+  events and metrics (ref: transports/nats.rs, kv_router.rs:60-66),
+- **work queues** (push + blocking pull) used as the disaggregation prefill
+  queue (ref: ``NatsQueue`` transports/nats.rs:426).
+
+Framing: 4-byte big-endian length + msgpack body. Requests carry a ``seq``;
+responses echo it; watch events are pushed with ``seq: None`` and a
+``watch_id``. One asyncio server task per connection; state is single-threaded
+within the server loop, so operations are atomic without locks.
+
+Run standalone: ``python -m dynamo_tpu.runtime.store --port 3280``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from ..utils.logging import get_logger
+
+log = get_logger("store")
+
+DEFAULT_PORT = 3280
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+# ------------------------------- framing ---------------------------------
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    size = int.from_bytes(header, "big")
+    if size > _MAX_FRAME:
+        raise ValueError(f"frame too large: {size}")
+    try:
+        body = await reader.readexactly(size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    writer.write(len(body).to_bytes(4, "big") + body)
+
+
+# ------------------------------- server ----------------------------------
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl_s: float
+    deadline: float
+    keys: set = field(default_factory=set)
+
+
+@dataclass
+class _KvEntry:
+    value: bytes
+    lease_id: int  # 0 = no lease
+    create_rev: int
+    mod_rev: int
+
+
+@dataclass
+class _Watch:
+    watch_id: int
+    prefix: str
+    writer: asyncio.StreamWriter
+
+
+class _WorkQueue:
+    """Push/blocking-pull queue (the JetStream work-queue role)."""
+
+    def __init__(self) -> None:
+        self.items: List[bytes] = []
+        self.waiters: List[asyncio.Future] = []
+
+    def push(self, payload: bytes) -> int:
+        while self.waiters:
+            fut = self.waiters.pop(0)
+            if not fut.done():
+                fut.set_result(payload)
+                return len(self.items)
+        self.items.append(payload)
+        return len(self.items)
+
+    def pop_nowait(self) -> Optional[bytes]:
+        return self.items.pop(0) if self.items else None
+
+
+class StoreServer:
+    """In-memory revisioned lease-KV store served over TCP."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        self._kv: Dict[str, _KvEntry] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._watches: Dict[int, _Watch] = {}
+        self._subs: Dict[int, _Watch] = {}  # pub/sub subjects (no storage)
+        self._queues: Dict[str, "_WorkQueue"] = {}
+        self._locks: Dict[str, Tuple[int, int]] = {}  # name -> (lease_id, watch count)
+        self._revision = 0
+        self._ids = itertools.count(1)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+        self._conn_writers: set = set()
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.create_task(self._expire_loop())
+        log.info("store listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._expiry_task:
+            self._expiry_task.cancel()
+        for writer in list(self._conn_writers):
+            writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    # -- lease expiry --
+
+    async def _expire_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            dead = [l for l in self._leases.values() if l.deadline < now]
+            for lease in dead:
+                log.info("lease %d expired (ttl %.1fs)", lease.lease_id, lease.ttl_s)
+                self._revoke(lease.lease_id)
+
+    def _revoke(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            self._delete_key(key)
+        for name, (owner, _) in list(self._locks.items()):
+            if owner == lease_id:
+                del self._locks[name]
+
+    # -- kv ops (single-threaded within the event loop => atomic) --
+
+    def _notify(self, event: str, key: str, value: Optional[bytes], rev: int) -> None:
+        for watch in list(self._watches.values()):
+            if key.startswith(watch.prefix):
+                try:
+                    write_frame(
+                        watch.writer,
+                        {
+                            "seq": None,
+                            "watch_id": watch.watch_id,
+                            "event": event,
+                            "key": key,
+                            "value": value,
+                            "rev": rev,
+                        },
+                    )
+                except Exception:
+                    self._watches.pop(watch.watch_id, None)
+
+    def _put(self, key: str, value: bytes, lease_id: int) -> int:
+        self._revision += 1
+        prev = self._kv.get(key)
+        create_rev = prev.create_rev if prev else self._revision
+        if prev and prev.lease_id and prev.lease_id != lease_id:
+            old = self._leases.get(prev.lease_id)
+            if old:
+                old.keys.discard(key)
+        self._kv[key] = _KvEntry(value, lease_id, create_rev, self._revision)
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise KeyError(f"unknown lease {lease_id}")
+            lease.keys.add(key)
+        self._notify("put", key, value, self._revision)
+        return self._revision
+
+    def _delete_key(self, key: str) -> bool:
+        entry = self._kv.pop(key, None)
+        if entry is None:
+            return False
+        self._revision += 1
+        if entry.lease_id:
+            lease = self._leases.get(entry.lease_id)
+            if lease:
+                lease.keys.discard(key)
+        self._notify("delete", key, None, self._revision)
+        return True
+
+    # -- request dispatch --
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn_watches: List[int] = []
+        conn_leases: List[int] = []
+        self._conn_writers.add(writer)
+        try:
+            while True:
+                msg = await read_frame(reader)
+                if msg is None:
+                    break
+                resp = self._dispatch(msg, writer, conn_watches, conn_leases)
+                if resp is not None:
+                    write_frame(writer, resp)
+                    await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:  # malformed frame / codec garbage: drop the conn
+            log.warning("dropping store connection after bad frame", exc_info=True)
+        finally:
+            for wid in conn_watches:
+                self._watches.pop(wid, None)
+                self._subs.pop(wid, None)
+            # leases owned by this connection survive until TTL expiry — a
+            # reconnecting client can re-attach via keepalive (etcd semantics)
+            self._conn_writers.discard(writer)
+            writer.close()
+
+    def _dispatch(
+        self,
+        msg: dict,
+        writer: asyncio.StreamWriter,
+        conn_watches: List[int],
+        conn_leases: List[int],
+    ) -> Optional[dict]:
+        op = msg.get("op")
+        seq = msg.get("seq")
+        try:
+            if op == "put":
+                rev = self._put(msg["key"], msg["value"], msg.get("lease", 0))
+                return {"seq": seq, "ok": True, "rev": rev}
+            if op == "create":  # atomic create: fail if key exists (kv_create)
+                if msg["key"] in self._kv:
+                    return {"seq": seq, "ok": False, "error": "exists"}
+                rev = self._put(msg["key"], msg["value"], msg.get("lease", 0))
+                return {"seq": seq, "ok": True, "rev": rev}
+            if op == "cas":
+                entry = self._kv.get(msg["key"])
+                expect = msg.get("expect")  # None = must not exist
+                actual = entry.value if entry else None
+                if actual != expect:
+                    return {"seq": seq, "ok": False, "error": "conflict",
+                            "value": actual}
+                rev = self._put(msg["key"], msg["value"], msg.get("lease", 0))
+                return {"seq": seq, "ok": True, "rev": rev}
+            if op == "get":
+                entry = self._kv.get(msg["key"])
+                if entry is None:
+                    return {"seq": seq, "ok": True, "kvs": []}
+                return {
+                    "seq": seq,
+                    "ok": True,
+                    "kvs": [[msg["key"], entry.value, entry.lease_id, entry.mod_rev]],
+                }
+            if op == "get_prefix":
+                prefix = msg["prefix"]
+                kvs = [
+                    [k, e.value, e.lease_id, e.mod_rev]
+                    for k, e in sorted(self._kv.items())
+                    if k.startswith(prefix)
+                ]
+                return {"seq": seq, "ok": True, "kvs": kvs, "rev": self._revision}
+            if op == "delete":
+                existed = self._delete_key(msg["key"])
+                return {"seq": seq, "ok": True, "deleted": existed}
+            if op == "delete_prefix":
+                keys = [k for k in self._kv if k.startswith(msg["prefix"])]
+                for k in keys:
+                    self._delete_key(k)
+                return {"seq": seq, "ok": True, "deleted": len(keys)}
+            if op == "lease_grant":
+                lease_id = next(self._ids)
+                ttl = float(msg.get("ttl", 10.0))
+                self._leases[lease_id] = _Lease(
+                    lease_id, ttl, time.monotonic() + ttl
+                )
+                conn_leases.append(lease_id)
+                return {"seq": seq, "ok": True, "lease": lease_id, "ttl": ttl}
+            if op == "lease_keepalive":
+                lease = self._leases.get(msg["lease"])
+                if lease is None:
+                    return {"seq": seq, "ok": False, "error": "lease_expired"}
+                lease.deadline = time.monotonic() + lease.ttl_s
+                return {"seq": seq, "ok": True, "ttl": lease.ttl_s}
+            if op == "lease_revoke":
+                self._revoke(msg["lease"])
+                return {"seq": seq, "ok": True}
+            if op == "watch":
+                watch_id = next(self._ids)
+                self._watches[watch_id] = _Watch(watch_id, msg["prefix"], writer)
+                conn_watches.append(watch_id)
+                # current state snapshot so the watcher can't miss anything
+                kvs = [
+                    [k, e.value, e.lease_id, e.mod_rev]
+                    for k, e in sorted(self._kv.items())
+                    if k.startswith(msg["prefix"])
+                ]
+                return {
+                    "seq": seq,
+                    "ok": True,
+                    "watch_id": watch_id,
+                    "kvs": kvs,
+                    "rev": self._revision,
+                }
+            if op == "unwatch":
+                self._watches.pop(msg["watch_id"], None)
+                return {"seq": seq, "ok": True}
+            if op == "lock":
+                name, lease_id = msg["name"], msg["lease"]
+                if lease_id not in self._leases:
+                    return {"seq": seq, "ok": False, "error": "lease_expired"}
+                holder = self._locks.get(name)
+                if holder is None or holder[0] not in self._leases:
+                    self._locks[name] = (lease_id, 0)
+                    return {"seq": seq, "ok": True, "acquired": True}
+                return {"seq": seq, "ok": True, "acquired": holder[0] == lease_id}
+            if op == "unlock":
+                holder = self._locks.get(msg["name"])
+                if holder and holder[0] == msg["lease"]:
+                    del self._locks[msg["name"]]
+                return {"seq": seq, "ok": True}
+            if op == "subscribe":
+                sub_id = next(self._ids)
+                self._subs[sub_id] = _Watch(sub_id, msg["subject"], writer)
+                conn_watches.append(sub_id)  # cleaned with watches on disconnect
+                return {"seq": seq, "ok": True, "watch_id": sub_id}
+            if op == "unsubscribe":
+                self._subs.pop(msg["watch_id"], None)
+                return {"seq": seq, "ok": True}
+            if op == "publish":
+                subject, payload = msg["subject"], msg["payload"]
+                n = 0
+                for sub in list(self._subs.values()):
+                    if subject.startswith(sub.prefix):
+                        try:
+                            write_frame(
+                                sub.writer,
+                                {"seq": None, "watch_id": sub.watch_id,
+                                 "event": "msg", "key": subject,
+                                 "value": payload, "rev": 0},
+                            )
+                            n += 1
+                        except Exception:
+                            self._subs.pop(sub.watch_id, None)
+                return {"seq": seq, "ok": True, "delivered": n}
+            if op == "q_push":
+                q = self._queues.setdefault(msg["queue"], _WorkQueue())
+                depth = q.push(msg["payload"])
+                return {"seq": seq, "ok": True, "depth": depth}
+            if op == "q_pop":
+                q = self._queues.setdefault(msg["queue"], _WorkQueue())
+                item = q.pop_nowait()
+                if item is not None:
+                    return {"seq": seq, "ok": True, "payload": item}
+                self._q_pop_async(q, msg, writer)
+                return None  # response written when an item arrives / timeout
+            if op == "q_len":
+                q = self._queues.get(msg["queue"])
+                return {"seq": seq, "ok": True,
+                        "depth": len(q.items) if q else 0}
+            if op == "ping":
+                return {"seq": seq, "ok": True, "rev": self._revision}
+            return {"seq": seq, "ok": False, "error": f"unknown op {op!r}"}
+        except Exception as exc:  # noqa: BLE001 — report, don't kill the conn
+            log.exception("store op %s failed", op)
+            return {"seq": seq, "ok": False, "error": str(exc)}
+
+    def _q_pop_async(
+        self, q: "_WorkQueue", msg: dict, writer: asyncio.StreamWriter
+    ) -> None:
+        """Blocking pull: respond when an item arrives or the timeout fires.
+        If the consumer vanished by delivery time, the item is re-queued
+        (at-least-once, the JetStream work-queue contract)."""
+        seq = msg.get("seq")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        q.waiters.append(fut)
+
+        def _deliver(f: asyncio.Future) -> None:
+            if f.cancelled():
+                payload = None
+            else:
+                payload = f.result()
+            if writer.is_closing():
+                if payload is not None:
+                    q.push(payload)
+                return
+            try:
+                write_frame(writer, {"seq": seq, "ok": True, "payload": payload})
+            except Exception:
+                if payload is not None:
+                    q.push(payload)
+
+        fut.add_done_callback(_deliver)
+        timeout = float(msg.get("timeout", 30.0))
+
+        def _expire() -> None:
+            if not fut.done():
+                fut.cancel()
+                try:
+                    q.waiters.remove(fut)
+                except ValueError:
+                    pass
+
+        asyncio.get_running_loop().call_later(timeout, _expire)
+
+
+# ------------------------------- client ----------------------------------
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class LeaseExpired(StoreError):
+    pass
+
+
+class StoreClient:
+    """Async client for :class:`StoreServer`.
+
+    Holds one multiplexed connection; a background reader routes responses by
+    ``seq`` and fans watch events out to per-watch queues. A *primary lease*
+    with automatic keepalive mirrors the reference runtime's liveness contract:
+    if the primary lease cannot be kept alive, ``on_lease_lost`` fires (the
+    runtime uses this to trigger shutdown, ref: etcd.rs:89-95).
+    """
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watch_queues: Dict[int, asyncio.Queue] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self.primary_lease: int = 0
+        self.on_lease_lost: Optional[Callable[[], None]] = None
+        self._closed = False
+
+    @staticmethod
+    async def connect(
+        addr: str, *, lease_ttl_s: float = 10.0, retries: int = 40,
+        retry_delay_s: float = 0.25,
+    ) -> "StoreClient":
+        host, port = addr.rsplit(":", 1)
+        client = StoreClient(host, int(port))
+        last: Optional[Exception] = None
+        for _ in range(retries):
+            try:
+                await client._open()
+                break
+            except OSError as exc:
+                last = exc
+                await asyncio.sleep(retry_delay_s)
+        else:
+            raise StoreError(f"cannot connect to store at {addr}: {last}")
+        client.primary_lease = await client.lease_grant(lease_ttl_s)
+        client._keepalive_task = asyncio.create_task(
+            client._keepalive_loop(lease_ttl_s)
+        )
+        return client
+
+    async def _open(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        # revoke while the reader is still alive so the response resolves
+        if self.primary_lease and self._writer and not self._writer.is_closing():
+            try:
+                await asyncio.wait_for(
+                    self._call({"op": "lease_revoke", "lease": self.primary_lease}),
+                    timeout=2.0,
+                )
+            except Exception:
+                pass
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            msg = await read_frame(self._reader)
+            if msg is None:
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(StoreError("store connection closed"))
+                self._pending.clear()
+                for q in self._watch_queues.values():
+                    q.put_nowait(None)
+                return
+            seq = msg.get("seq")
+            if seq is None:
+                q = self._watch_queues.get(msg.get("watch_id"))
+                if q is not None:
+                    q.put_nowait(msg)
+            else:
+                fut = self._pending.pop(seq, None)
+                if fut and not fut.done():
+                    fut.set_result(msg)
+
+    async def _call(self, msg: dict) -> dict:
+        if self._writer is None or self._writer.is_closing():
+            raise StoreError("store client not connected")
+        seq = next(self._seq)
+        msg["seq"] = seq
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        write_frame(self._writer, msg)
+        await self._writer.drain()
+        return await fut
+
+    async def _keepalive_loop(self, ttl_s: float) -> None:
+        period = max(ttl_s / 3.0, 0.2)
+        while not self._closed:
+            await asyncio.sleep(period)
+            try:
+                resp = await asyncio.wait_for(
+                    self._call(
+                        {"op": "lease_keepalive", "lease": self.primary_lease}
+                    ),
+                    timeout=ttl_s,
+                )
+                if not resp.get("ok"):
+                    raise LeaseExpired("primary lease expired")
+            except Exception:
+                if self._closed:
+                    return
+                log.error("primary lease keepalive failed — signalling lease loss")
+                if self.on_lease_lost:
+                    self.on_lease_lost()
+                return
+
+    # -- public kv api --
+
+    async def put(self, key: str, value: bytes, lease: int = 0) -> int:
+        resp = await self._call(
+            {"op": "put", "key": key, "value": value, "lease": lease}
+        )
+        if not resp["ok"]:
+            raise StoreError(resp.get("error", "put failed"))
+        return resp["rev"]
+
+    async def create(self, key: str, value: bytes, lease: int = 0) -> bool:
+        """Atomic create; False if the key already exists (ref: kv_create)."""
+        resp = await self._call(
+            {"op": "create", "key": key, "value": value, "lease": lease}
+        )
+        return bool(resp["ok"])
+
+    async def cas(
+        self, key: str, expect: Optional[bytes], value: bytes, lease: int = 0
+    ) -> bool:
+        resp = await self._call(
+            {"op": "cas", "key": key, "expect": expect, "value": value,
+             "lease": lease}
+        )
+        return bool(resp["ok"])
+
+    async def get(self, key: str) -> Optional[bytes]:
+        resp = await self._call({"op": "get", "key": key})
+        kvs = resp.get("kvs", [])
+        return kvs[0][1] if kvs else None
+
+    async def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        resp = await self._call({"op": "get_prefix", "prefix": prefix})
+        return [(k, v) for k, v, _lease, _rev in resp.get("kvs", [])]
+
+    async def delete(self, key: str) -> bool:
+        resp = await self._call({"op": "delete", "key": key})
+        return bool(resp.get("deleted"))
+
+    async def delete_prefix(self, prefix: str) -> int:
+        resp = await self._call({"op": "delete_prefix", "prefix": prefix})
+        return int(resp.get("deleted", 0))
+
+    async def lease_grant(self, ttl_s: float) -> int:
+        resp = await self._call({"op": "lease_grant", "ttl": ttl_s})
+        if not resp["ok"]:
+            raise StoreError(resp.get("error", "lease_grant failed"))
+        return resp["lease"]
+
+    async def lease_revoke(self, lease: int) -> None:
+        await self._call({"op": "lease_revoke", "lease": lease})
+
+    async def lock(self, name: str, lease: Optional[int] = None) -> bool:
+        resp = await self._call(
+            {"op": "lock", "name": name, "lease": lease or self.primary_lease}
+        )
+        return bool(resp.get("acquired"))
+
+    async def unlock(self, name: str, lease: Optional[int] = None) -> None:
+        await self._call(
+            {"op": "unlock", "name": name, "lease": lease or self.primary_lease}
+        )
+
+    async def watch_prefix(
+        self, prefix: str
+    ) -> Tuple[List[Tuple[str, bytes]], "WatchStream"]:
+        """Subscribe to a prefix; returns (current snapshot, event stream)."""
+        resp = await self._call({"op": "watch", "prefix": prefix})
+        if not resp["ok"]:
+            raise StoreError(resp.get("error", "watch failed"))
+        watch_id = resp["watch_id"]
+        queue: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[watch_id] = queue
+        snapshot = [(k, v) for k, v, _l, _r in resp.get("kvs", [])]
+        return snapshot, WatchStream(self, watch_id, queue)
+
+    # -- pub/sub (NATS-subject role) --
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        resp = await self._call(
+            {"op": "publish", "subject": subject, "payload": payload}
+        )
+        return int(resp.get("delivered", 0))
+
+    async def subscribe(self, subject_prefix: str) -> "WatchStream":
+        """Subscribe to a subject prefix; events have ``event == 'msg'``."""
+        resp = await self._call({"op": "subscribe", "subject": subject_prefix})
+        if not resp["ok"]:
+            raise StoreError(resp.get("error", "subscribe failed"))
+        watch_id = resp["watch_id"]
+        queue: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[watch_id] = queue
+        return WatchStream(self, watch_id, queue, kind="subscribe")
+
+    # -- work queues (JetStream pull-consumer role, ref: nats.rs:426) --
+
+    async def q_push(self, queue: str, payload: bytes) -> int:
+        resp = await self._call({"op": "q_push", "queue": queue, "payload": payload})
+        return int(resp.get("depth", 0))
+
+    async def q_pop(self, queue: str, timeout_s: float = 30.0) -> Optional[bytes]:
+        resp = await self._call(
+            {"op": "q_pop", "queue": queue, "timeout": timeout_s}
+        )
+        return resp.get("payload")
+
+    async def q_len(self, queue: str) -> int:
+        resp = await self._call({"op": "q_len", "queue": queue})
+        return int(resp.get("depth", 0))
+
+    async def wait_for_key_count(
+        self, prefix: str, count: int, timeout_s: float = 60.0
+    ) -> List[Tuple[str, bytes]]:
+        """Block until >= ``count`` keys exist under ``prefix``
+        (ref: leader_worker_barrier.rs:24)."""
+        snapshot, stream = await self.watch_prefix(prefix)
+        try:
+            seen = dict(snapshot)
+            deadline = time.monotonic() + timeout_s
+            while len(seen) < count:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"barrier timeout: {len(seen)}/{count} under {prefix!r}"
+                    )
+                event = await asyncio.wait_for(stream.next(), timeout=remaining)
+                if event is None:
+                    raise StoreError("store connection lost during barrier")
+                if event["event"] == "put":
+                    seen[event["key"]] = event["value"]
+                else:
+                    seen.pop(event["key"], None)
+            return sorted(seen.items())
+        finally:
+            await stream.cancel()
+
+
+class WatchStream:
+    """Stream of {'event': 'put'|'delete', 'key', 'value', 'rev'} dicts."""
+
+    def __init__(
+        self,
+        client: StoreClient,
+        watch_id: int,
+        queue: asyncio.Queue,
+        kind: str = "watch",
+    ):
+        self._client = client
+        self.watch_id = watch_id
+        self._queue = queue
+        self._kind = kind
+
+    async def next(self) -> Optional[dict]:
+        return await self._queue.get()
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            event = await self._queue.get()
+            if event is None:
+                return
+            yield event
+
+    async def cancel(self) -> None:
+        self._client._watch_queues.pop(self.watch_id, None)
+        op = "unwatch" if self._kind == "watch" else "unsubscribe"
+        try:
+            await self._client._call({"op": op, "watch_id": self.watch_id})
+        except StoreError:
+            pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu discovery store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    server = StoreServer(args.host, args.port)
+    asyncio.run(server.serve_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,343 @@
+"""Request-push + response-stream transport over TCP with a two-part codec.
+
+The data plane between routers and workers. The reference pushes requests over
+NATS and streams responses back over a separate TCP connection with a
+length-prefixed two-part (header + payload) codec (ref: lib/runtime/src/
+pipeline/network/egress/addressed_router.rs:29-161, tcp/server.rs:62,
+codec/two_part.rs:11,157). TPU-native redesign: routers hold pooled,
+multiplexed TCP connections directly to worker ingress servers — one
+round-trip fewer than the NATS-push-then-TCP-connect-back handshake, same
+capability (streaming, cancellation, backpressure via TCP flow control).
+
+Frames are msgpack with a 4-byte length prefix (shared with the store codec).
+Two-part shape preserved: a small control header dict + an opaque ``payload``
+bytes field that hot paths pass through without re-encoding.
+
+Frame types:
+  client → server:  {t: "req",    rid, headers: {...}, payload: bytes}
+                    {t: "cancel", rid, kill: bool}
+  server → client:  {t: "data",   rid, payload: bytes}
+                    {t: "end",    rid}          (stream complete sentinel)
+                    {t: "err",    rid, error, code}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+from ..utils.logging import TraceContext, get_logger
+from .context import Context
+from .engine import AsyncEngine
+from .store import read_frame, write_frame
+
+log = get_logger("transport")
+
+# error codes surfaced to the Migration operator's retry policy
+ERR_APP = "application"          # handler raised — not retryable
+ERR_UNAVAILABLE = "unavailable"  # connect failed / conn dropped — retryable
+ERR_OVERLOADED = "overloaded"    # worker rejected (busy threshold) — retryable
+
+
+class EngineError(RuntimeError):
+    def __init__(self, message: str, code: str = ERR_APP):
+        super().__init__(message)
+        self.code = code
+
+
+class IngressServer:
+    """Worker-side endpoint server: accepts pushed requests, runs the handler
+    engine, streams responses back (ref: pipeline/network/ingress/
+    push_endpoint.rs)."""
+
+    def __init__(
+        self,
+        engine: AsyncEngine,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        max_inflight: Optional[int] = None,
+    ):
+        self._engine = engine
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._inflight: Dict[str, asyncio.Task] = {}
+        self._contexts: Dict[str, Context] = {}
+        self._conn_writers: set = set()
+        self._sem = asyncio.Semaphore(max_inflight) if max_inflight else None
+        self.draining = False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for task in list(self._inflight.values()):
+            task.cancel()
+        for writer in list(self._conn_writers):
+            writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def join(self) -> None:
+        """Wait for in-flight requests to finish (graceful shutdown drain)."""
+        while self._inflight:
+            await asyncio.wait(list(self._inflight.values()))
+
+    @property
+    def num_inflight(self) -> int:
+        return len(self._inflight)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        write_lock = asyncio.Lock()
+        conn_rids: set = set()
+        self._conn_writers.add(writer)
+        try:
+            while True:
+                msg = await read_frame(reader)
+                if msg is None:
+                    break
+                t = msg.get("t")
+                if t == "req":
+                    rid = msg["rid"]
+                    conn_rids.add(rid)
+                    task = asyncio.create_task(
+                        self._run_request(msg, writer, write_lock)
+                    )
+                    self._inflight[rid] = task
+                    task.add_done_callback(
+                        lambda _t, rid=rid: (
+                            self._inflight.pop(rid, None),
+                            self._contexts.pop(rid, None),
+                        )
+                    )
+                elif t == "cancel":
+                    ctx = self._contexts.get(msg["rid"])
+                    if ctx is not None:
+                        if msg.get("kill"):
+                            ctx.kill()
+                        else:
+                            ctx.stop_generating()
+                elif t == "ping":
+                    async with write_lock:
+                        write_frame(writer, {"t": "pong"})
+                        await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:  # malformed frame / codec garbage: drop the conn
+            log.warning("dropping ingress connection after bad frame",
+                        exc_info=True)
+        finally:
+            # peer gone: kill every stream that was feeding this connection
+            for rid in conn_rids:
+                ctx = self._contexts.get(rid)
+                if ctx is not None:
+                    ctx.kill()
+            self._conn_writers.discard(writer)
+            writer.close()
+
+    async def _run_request(
+        self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock
+    ) -> None:
+        rid = msg["rid"]
+        headers = msg.get("headers") or {}
+        trace = None
+        if headers.get("traceparent"):
+            trace = TraceContext.parse(headers["traceparent"])
+        ctx = Context(request_id=headers.get("x-request-id") or rid, trace=trace)
+        self._contexts[rid] = ctx
+
+        async def send(obj: dict) -> None:
+            async with write_lock:
+                write_frame(writer, obj)
+                await writer.drain()
+
+        if self.draining:
+            await send({"t": "err", "rid": rid, "error": "draining",
+                        "code": ERR_UNAVAILABLE})
+            return
+        if self._sem is not None and self._sem.locked():
+            await send({"t": "err", "rid": rid, "error": "worker overloaded",
+                        "code": ERR_OVERLOADED})
+            return
+        if self._sem is not None:
+            await self._sem.acquire()
+        try:
+            request = msgpack.unpackb(msg["payload"], raw=False)
+            async for item in self._engine.generate(request, ctx):
+                if ctx.is_killed():
+                    break
+                await send(
+                    {"t": "data", "rid": rid,
+                     "payload": msgpack.packb(item, use_bin_type=True)}
+                )
+            if not ctx.is_killed():
+                await send({"t": "end", "rid": rid})
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.kill()
+        except EngineError as exc:
+            try:
+                await send({"t": "err", "rid": rid, "error": str(exc),
+                            "code": exc.code})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        except Exception as exc:  # noqa: BLE001
+            log.exception("handler failed for request %s", rid)
+            try:
+                await send({"t": "err", "rid": rid, "error": str(exc),
+                            "code": ERR_APP})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            if self._sem is not None:
+                self._sem.release()
+
+
+class _Conn:
+    """One multiplexed client connection with a demux reader."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.streams: Dict[str, asyncio.Queue] = {}
+        self.write_lock = asyncio.Lock()
+        self.reader_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    async def demux(self) -> None:
+        while True:
+            msg = await read_frame(self.reader)
+            if msg is None:
+                break
+            q = self.streams.get(msg.get("rid"))
+            if q is not None:
+                q.put_nowait(msg)
+        self.closed = True
+        for q in self.streams.values():
+            q.put_nowait(None)
+
+    def close(self) -> None:
+        self.closed = True
+        if self.reader_task:
+            self.reader_task.cancel()
+        self.writer.close()
+
+
+class TransportClient:
+    """Router-side client: pooled multiplexed connections keyed by address."""
+
+    def __init__(self):
+        self._conns: Dict[str, _Conn] = {}
+        self._rids = itertools.count(1)
+        self._conn_locks: Dict[str, asyncio.Lock] = {}
+
+    async def _get_conn(self, addr: str) -> _Conn:
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            host, port = addr.rsplit(":", 1)
+            try:
+                reader, writer = await asyncio.open_connection(host, int(port))
+            except OSError as exc:
+                raise EngineError(
+                    f"cannot connect to worker at {addr}: {exc}", ERR_UNAVAILABLE
+                ) from exc
+            conn = _Conn(reader, writer)
+            conn.reader_task = asyncio.create_task(conn.demux())
+            self._conns[addr] = conn
+            return conn
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+
+    async def generate(
+        self, addr: str, request: object, context: Context
+    ) -> AsyncIterator[object]:
+        """Push a request to ``addr``; yield the response stream.
+
+        Raises :class:`EngineError` with a retryability code — the Migration
+        operator upstream decides whether to re-issue (ref: migration.rs:88).
+        """
+        conn = await self._get_conn(addr)
+        rid = f"{context.id}-{next(self._rids)}"
+        queue: asyncio.Queue = asyncio.Queue()
+        conn.streams[rid] = queue
+        headers = {
+            "traceparent": context.trace.child().traceparent(),
+            "x-request-id": context.id,
+        }
+        try:
+            async with conn.write_lock:
+                write_frame(
+                    conn.writer,
+                    {"t": "req", "rid": rid, "headers": headers,
+                     "payload": msgpack.packb(request, use_bin_type=True)},
+                )
+                await conn.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError) as exc:
+            conn.streams.pop(rid, None)
+            conn.close()
+            raise EngineError(f"worker {addr} send failed: {exc}", ERR_UNAVAILABLE)
+
+        cancel_sent = False
+        try:
+            while True:
+                get = asyncio.create_task(queue.get())
+                stop = asyncio.create_task(context.wait_stopped())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for p in pending:
+                    p.cancel()
+                if stop in done and get not in done:
+                    if not cancel_sent:
+                        cancel_sent = True
+                        await self._send_cancel(conn, rid, context.is_killed())
+                    if context.is_killed():
+                        return
+                    # graceful stop: keep draining until the worker ends the
+                    # stream (it emits the tokens generated so far)
+                    msg = await queue.get()
+                else:
+                    msg = get.result()
+                if msg is None:
+                    raise EngineError(
+                        f"worker {addr} connection dropped mid-stream",
+                        ERR_UNAVAILABLE,
+                    )
+                t = msg.get("t")
+                if t == "data":
+                    yield msgpack.unpackb(msg["payload"], raw=False)
+                elif t == "end":
+                    return
+                elif t == "err":
+                    raise EngineError(
+                        msg.get("error", "worker error"),
+                        msg.get("code", ERR_APP),
+                    )
+        finally:
+            conn.streams.pop(rid, None)
+            if (context.is_stopped() or context.is_killed()) and not cancel_sent:
+                await self._send_cancel(conn, rid, context.is_killed())
+
+    async def _send_cancel(self, conn: _Conn, rid: str, kill: bool) -> None:
+        if conn.closed:
+            return
+        try:
+            async with conn.write_lock:
+                write_frame(conn.writer, {"t": "cancel", "rid": rid, "kill": kill})
+                await conn.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass

@@ -1,0 +1,3 @@
+"""Host-side utilities: layered config, JSONL logging with W3C trace context,
+hierarchical Prometheus metrics (ref: lib/runtime/src/{config.rs,logging.rs,
+metrics.rs})."""

@@ -1,0 +1,105 @@
+"""Layered runtime configuration.
+
+Equivalent role to the reference's figment-based ``RuntimeConfig``
+(ref: lib/runtime/src/config.rs:72,194-244): defaults < config file (TOML/JSON)
+< environment variables, with the ``DYNTPU_`` prefix (the reference uses
+``DYN_``). Typed accessors with bool/int/float coercion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+ENV_PREFIX = "DYNTPU_"
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(f"cannot parse boolean env {name}={raw!r}")
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    return default if raw is None or raw == "" else int(raw)
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    return default if raw is None or raw == "" else float(raw)
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Process-wide runtime settings, layered from file + env.
+
+    Fields mirror the reference's runtime knobs (worker thread counts become
+    asyncio/executor sizing here; etcd/NATS addresses become the store/
+    transport addresses of our own control plane).
+    """
+
+    namespace: str = "dynamo"
+    store_addr: str = "127.0.0.1:3280"  # lease-KV discovery store (etcd role)
+    system_port: int = 0  # 0 = disabled; /health /live /metrics server
+    system_enabled: bool = False
+    request_timeout_s: float = 600.0
+    health_check_enabled: bool = False
+    health_check_period_s: float = 10.0
+    lease_ttl_s: float = 10.0  # ref: transports/etcd.rs:89-95 (10 s TTL)
+    jsonl_logging: bool = False
+    log_level: str = "INFO"
+    num_io_threads: int = 8
+
+    @staticmethod
+    def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
+        cfg = RuntimeConfig()
+        file_path = path or os.environ.get(ENV_PREFIX + "CONFIG")
+        if file_path and Path(file_path).exists():
+            data = json.loads(Path(file_path).read_text())
+            for k, v in data.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+        # env layer wins
+        cfg.namespace = env_str(ENV_PREFIX + "NAMESPACE", cfg.namespace)
+        cfg.store_addr = env_str(ENV_PREFIX + "STORE_ADDR", cfg.store_addr)
+        cfg.system_port = env_int(ENV_PREFIX + "SYSTEM_PORT", cfg.system_port)
+        cfg.system_enabled = env_flag(ENV_PREFIX + "SYSTEM_ENABLED", cfg.system_enabled)
+        cfg.request_timeout_s = env_float(
+            ENV_PREFIX + "REQUEST_TIMEOUT_S", cfg.request_timeout_s
+        )
+        cfg.health_check_enabled = env_flag(
+            ENV_PREFIX + "HEALTH_CHECK_ENABLED", cfg.health_check_enabled
+        )
+        cfg.health_check_period_s = env_float(
+            ENV_PREFIX + "HEALTH_CHECK_PERIOD_S", cfg.health_check_period_s
+        )
+        cfg.lease_ttl_s = env_float(ENV_PREFIX + "LEASE_TTL_S", cfg.lease_ttl_s)
+        cfg.jsonl_logging = env_flag(ENV_PREFIX + "JSONL_LOGGING", cfg.jsonl_logging)
+        cfg.log_level = env_str(ENV_PREFIX + "LOG_LEVEL", cfg.log_level)
+        cfg.num_io_threads = env_int(ENV_PREFIX + "IO_THREADS", cfg.num_io_threads)
+        return cfg
+
+    @property
+    def store_host(self) -> str:
+        return self.store_addr.rsplit(":", 1)[0]
+
+    @property
+    def store_port(self) -> int:
+        return int(self.store_addr.rsplit(":", 1)[1])

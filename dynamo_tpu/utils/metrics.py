@@ -1,0 +1,110 @@
+"""Hierarchical Prometheus metrics registries.
+
+Namespace/component/endpoint-scoped metric factories with automatic labels,
+equivalent to the reference's ``MetricsRegistry`` trait hierarchy
+(ref: lib/runtime/src/metrics.rs:365, metrics/prometheus_names.rs). Backed by
+``prometheus_client``; each scope shares one process ``CollectorRegistry`` and
+prefixes metric names + injects scope labels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# Frequency buckets tuned for LLM serving latencies (TTFT/ITL in seconds),
+# same role as the reference's http/service/metrics.rs histograms.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+class MetricsRegistry:
+    """A scope (runtime / namespace / component / endpoint) that mints metrics.
+
+    Child scopes share the root ``CollectorRegistry`` and accumulate constant
+    labels, mirroring the reference's auto-labelled hierarchy.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[CollectorRegistry] = None,
+        prefix: str = "dynamo",
+        const_labels: Optional[Dict[str, str]] = None,
+    ):
+        self.registry = registry or CollectorRegistry()
+        self.prefix = prefix
+        self.const_labels = dict(const_labels or {})
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def child(self, **labels: str) -> "MetricsRegistry":
+        merged = dict(self.const_labels)
+        merged.update(labels)
+        sub = MetricsRegistry(self.registry, self.prefix, merged)
+        sub._metrics = self._metrics  # share the mint cache across scopes
+        sub._lock = self._lock
+        return sub
+
+    def _full_name(self, name: str) -> str:
+        return f"{self.prefix}_{name}"
+
+    def _get_or_create(self, cls, name: str, doc: str, labelnames, **kwargs):
+        key = self._full_name(name)
+        with self._lock:
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = cls(
+                    key,
+                    doc,
+                    labelnames=tuple(labelnames),
+                    registry=self.registry,
+                    **kwargs,
+                )
+                self._metrics[key] = metric
+        return metric
+
+    def _labelnames(self, extra: Sequence[str]) -> tuple:
+        return tuple(self.const_labels.keys()) + tuple(extra)
+
+    def counter(self, name: str, doc: str, extra_labels: Sequence[str] = ()):
+        c = self._get_or_create(Counter, name, doc, self._labelnames(extra_labels))
+        return c.labels(**self.const_labels) if not extra_labels else _Bound(c, self.const_labels)
+
+    def gauge(self, name: str, doc: str, extra_labels: Sequence[str] = ()):
+        g = self._get_or_create(Gauge, name, doc, self._labelnames(extra_labels))
+        return g.labels(**self.const_labels) if not extra_labels else _Bound(g, self.const_labels)
+
+    def histogram(
+        self, name: str, doc: str, extra_labels: Sequence[str] = (), buckets=LATENCY_BUCKETS
+    ):
+        h = self._get_or_create(
+            Histogram, name, doc, self._labelnames(extra_labels), buckets=buckets
+        )
+        return h.labels(**self.const_labels) if not extra_labels else _Bound(h, self.const_labels)
+
+    def render(self) -> bytes:
+        """Prometheus text exposition of every metric in this process scope."""
+        return generate_latest(self.registry)
+
+
+class _Bound:
+    """Partially-bound metric: const labels applied, extra labels at call time."""
+
+    def __init__(self, metric, const_labels: Dict[str, str]):
+        self._metric = metric
+        self._const = const_labels
+
+    def labels(self, **extra: str):
+        merged = dict(self._const)
+        merged.update(extra)
+        return self._metric.labels(**merged)

@@ -1,0 +1,23 @@
+"""Test harness config.
+
+Forces an 8-device virtual CPU mesh BEFORE jax import so multi-chip sharding
+logic is exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench.py runs on the real chip). Async tests run under the
+anyio pytest plugin with the asyncio backend; coroutine tests are auto-marked.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,142 @@
+"""Component model e2e: runtime bring-up, serve_endpoint, discovery-driven
+client routing, worker death pruning (ref: component.rs + component/client.rs)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import link, AsyncEngine, FnEngine, Operator
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.runtime.transport import EngineError
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+@pytest.fixture
+async def cluster():
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    runtimes = []
+
+    async def make_runtime(**overrides):
+        cfg = RuntimeConfig(store_addr=f"127.0.0.1:{server.port}", **overrides)
+        rt = await DistributedRuntime.from_settings(cfg)
+        runtimes.append(rt)
+        return rt
+
+    yield make_runtime
+    for rt in runtimes:
+        await rt.shutdown()
+    await server.stop()
+
+
+async def worker_handler(request, context):
+    for tok in request["prompt"].split():
+        yield {"token": tok.upper()}
+
+
+async def test_serve_and_route(cluster):
+    worker_rt = await cluster()
+    frontend_rt = await cluster()
+
+    endpoint = worker_rt.namespace("test").component("backend").endpoint("generate")
+    await endpoint.serve_endpoint(worker_handler)
+
+    client = await (
+        frontend_rt.namespace("test").component("backend").endpoint("generate").client()
+    )
+    await client.wait_for_instances(1, timeout_s=5)
+
+    out = [
+        item async for item in client.round_robin({"prompt": "a b c"}, Context())
+    ]
+    assert out == [{"token": "A"}, {"token": "B"}, {"token": "C"}]
+
+
+async def test_round_robin_across_instances(cluster):
+    frontend_rt = await cluster()
+    for i in range(2):
+        rt = await cluster()
+        ep = rt.namespace("test").component("backend").endpoint("generate")
+
+        async def tagged(request, context, tag=i):
+            yield {"worker": tag}
+
+        await ep.serve_endpoint(tagged)
+
+    client = await (
+        frontend_rt.namespace("test").component("backend").endpoint("generate").client()
+    )
+    await client.wait_for_instances(2, timeout_s=5)
+    seen = set()
+    for _ in range(4):
+        async for item in client.round_robin({}, Context()):
+            seen.add(item["worker"])
+    assert seen == {0, 1}
+
+
+async def test_direct_routing(cluster):
+    frontend_rt = await cluster()
+    rt = await cluster()
+    ep = rt.namespace("test").component("backend").endpoint("generate")
+    served = await ep.serve_endpoint(worker_handler)
+
+    client = await (
+        frontend_rt.namespace("test").component("backend").endpoint("generate").client()
+    )
+    await client.wait_for_instances(1, timeout_s=5)
+    out = [
+        x async for x in client.direct(
+            served.instance.instance_id, {"prompt": "hi"}, Context()
+        )
+    ]
+    assert out == [{"token": "HI"}]
+
+
+async def test_worker_shutdown_prunes_instances(cluster):
+    frontend_rt = await cluster()
+    rt = await cluster(lease_ttl_s=0.5)
+    ep = rt.namespace("test").component("backend").endpoint("generate")
+    await ep.serve_endpoint(worker_handler)
+
+    client = await (
+        frontend_rt.namespace("test").component("backend").endpoint("generate").client()
+    )
+    await client.wait_for_instances(1, timeout_s=5)
+
+    removed = asyncio.Event()
+    client.on_instance_removed.append(lambda _id: removed.set())
+    await rt.shutdown()  # revokes primary lease → instance key deleted
+    await asyncio.wait_for(removed.wait(), 5)
+    assert client.instance_ids() == []
+    with pytest.raises(EngineError):
+        async for _ in client.round_robin({}, Context()):
+            pass
+
+
+async def test_no_instances_error(cluster):
+    rt = await cluster()
+    client = await (
+        rt.namespace("test").component("nothing").endpoint("generate").client()
+    )
+    with pytest.raises(EngineError):
+        async for _ in client.round_robin({}, Context()):
+            pass
+
+
+async def test_pipeline_link_forward_backward():
+    class Doubler(Operator):
+        async def forward(self, request, context):
+            return {"x": request["x"] * 2}
+
+        async def backward(self, stream, request, context):
+            async for item in stream:
+                yield {"y": item["y"] + 1}
+
+    async def sink(request, context):
+        yield {"y": request["x"]}
+
+    pipeline = link(Doubler(), FnEngine(sink))
+    out = [x async for x in pipeline.generate({"x": 5}, Context())]
+    assert out == [{"y": 11}]  # 5*2 → sink yields 10 → backward +1

@@ -1,0 +1,175 @@
+"""Lease-KV store: kv ops, leases, watches, pub/sub, queues, barrier
+(capability contract of ref transports/etcd.rs + nats.rs)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+
+@pytest.fixture
+async def store():
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    clients = []
+
+    async def connect(**kw):
+        c = await StoreClient.connect(f"127.0.0.1:{server.port}", **kw)
+        clients.append(c)
+        return c
+
+    yield connect
+    for c in clients:
+        await c.close()
+    await server.stop()
+
+
+async def test_put_get_delete(store):
+    c = await store()
+    await c.put("a/b", b"v1")
+    assert await c.get("a/b") == b"v1"
+    await c.put("a/b", b"v2")
+    assert await c.get("a/b") == b"v2"
+    assert await c.delete("a/b") is True
+    assert await c.get("a/b") is None
+    assert await c.delete("a/b") is False
+
+
+async def test_prefix_ops(store):
+    c = await store()
+    await c.put("p/1", b"1")
+    await c.put("p/2", b"2")
+    await c.put("q/1", b"3")
+    kvs = await c.get_prefix("p/")
+    assert [k for k, _ in kvs] == ["p/1", "p/2"]
+    assert await c.delete_prefix("p/") == 2
+    assert await c.get_prefix("p/") == []
+
+
+async def test_atomic_create(store):
+    c = await store()
+    assert await c.create("k", b"first") is True
+    assert await c.create("k", b"second") is False
+    assert await c.get("k") == b"first"
+
+
+async def test_cas(store):
+    c = await store()
+    assert await c.cas("c", None, b"v1") is True
+    assert await c.cas("c", b"wrong", b"v2") is False
+    assert await c.cas("c", b"v1", b"v2") is True
+    assert await c.get("c") == b"v2"
+
+
+async def test_lease_expiry_deletes_keys_and_notifies(store):
+    c = await store(lease_ttl_s=0.5)
+    watcher = await store()
+    snapshot, stream = await watcher.watch_prefix("inst/")
+    assert snapshot == []
+    await c.put("inst/worker1", b"addr", lease=c.primary_lease)
+    ev = await asyncio.wait_for(stream.next(), 2)
+    assert ev["event"] == "put" and ev["key"] == "inst/worker1"
+    # stop keepalives → lease expires server-side → key deleted
+    c._keepalive_task.cancel()
+    ev = await asyncio.wait_for(stream.next(), 5)
+    assert ev["event"] == "delete" and ev["key"] == "inst/worker1"
+    assert await watcher.get("inst/worker1") is None
+
+
+async def test_explicit_lease_revoke(store):
+    c = await store()
+    lease = await c.lease_grant(30.0)
+    await c.put("l/1", b"x", lease=lease)
+    await c.lease_revoke(lease)
+    assert await c.get("l/1") is None
+
+
+async def test_watch_snapshot_plus_events(store):
+    c = await store()
+    await c.put("w/1", b"old")
+    snapshot, stream = await c.watch_prefix("w/")
+    assert snapshot == [("w/1", b"old")]
+    await c.put("w/2", b"new")
+    ev = await asyncio.wait_for(stream.next(), 2)
+    assert (ev["event"], ev["key"], ev["value"]) == ("put", "w/2", b"new")
+    await stream.cancel()
+    await c.put("w/3", b"after-cancel")
+    await asyncio.sleep(0.1)
+    assert stream._queue.empty()
+
+
+async def test_pubsub(store):
+    pub = await store()
+    sub1 = await store()
+    sub2 = await store()
+    s1 = await sub1.subscribe("events/kv/")
+    s2 = await sub2.subscribe("events/kv/")
+    delivered = await pub.publish("events/kv/worker1", b"stored")
+    assert delivered == 2
+    for s in (s1, s2):
+        ev = await asyncio.wait_for(s.next(), 2)
+        assert ev["event"] == "msg"
+        assert ev["key"] == "events/kv/worker1"
+        assert ev["value"] == b"stored"
+    # no storage: new subscriber sees nothing
+    s3 = await (await store()).subscribe("events/kv/")
+    await asyncio.sleep(0.05)
+    assert s3._queue.empty()
+
+
+async def test_work_queue_fifo_and_blocking(store):
+    c = await store()
+    await c.q_push("prefill", b"r1")
+    await c.q_push("prefill", b"r2")
+    assert await c.q_len("prefill") == 2
+    assert await c.q_pop("prefill") == b"r1"
+    assert await c.q_pop("prefill") == b"r2"
+
+    async def delayed_push():
+        await asyncio.sleep(0.2)
+        await c.q_push("prefill", b"r3")
+
+    task = asyncio.create_task(delayed_push())
+    got = await asyncio.wait_for(c.q_pop("prefill", timeout_s=5), 3)
+    assert got == b"r3"
+    await task
+
+
+async def test_work_queue_pop_timeout(store):
+    c = await store()
+    got = await asyncio.wait_for(c.q_pop("empty", timeout_s=0.3), 2)
+    assert got is None
+
+
+async def test_lock(store):
+    a = await store()
+    b = await store()
+    assert await a.lock("the-lock") is True
+    assert await b.lock("the-lock") is False
+    await a.unlock("the-lock")
+    assert await b.lock("the-lock") is True
+
+
+async def test_leader_worker_barrier(store):
+    leader_store = await store()
+    worker_stores = [await store() for _ in range(3)]
+
+    async def leader():
+        return await LeaderBarrier("bringup", 3, timeout_s=10).sync(
+            leader_store, {"mesh": [2, 4]}
+        )
+
+    async def worker(i, s):
+        return await WorkerBarrier("bringup", f"w{i}", timeout_s=10).sync(
+            s, {"rank": i}
+        )
+
+    results = await asyncio.gather(
+        leader(), *(worker(i, s) for i, s in enumerate(worker_stores))
+    )
+    worker_payloads = results[0]
+    assert sorted(p["rank"] for p in worker_payloads) == [0, 1, 2]
+    for r in results[1:]:
+        assert r == {"mesh": [2, 4]}

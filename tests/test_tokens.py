@@ -1,0 +1,74 @@
+"""Token block hashing semantics (ref: lib/tokens/src/lib.rs, indexer.rs:125)."""
+
+import xxhash
+
+from dynamo_tpu.tokens import (
+    HASH_SEED,
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+    compute_sequence_hash,
+)
+
+
+def test_block_hash_is_xxh3_seeded():
+    tokens = [1, 2, 3, 4]
+    expected = xxhash.xxh3_64_intdigest(
+        b"".join(t.to_bytes(4, "little") for t in tokens), seed=HASH_SEED
+    )
+    assert compute_block_hash(tokens) == expected
+
+
+def test_sequence_hash_chains_parent():
+    a = compute_sequence_hash(None, [1, 2])
+    b = compute_sequence_hash(a, [3, 4])
+    b2 = compute_sequence_hash(a, [3, 4])
+    assert b == b2
+    assert b != compute_sequence_hash(None, [3, 4])
+
+
+def test_equal_prefixes_equal_hashes():
+    h1 = compute_block_hashes_for_seq(list(range(64)), 16)
+    h2 = compute_block_hashes_for_seq(list(range(64)) + [99, 100], 16)
+    assert len(h1) == 4
+    assert h2[:4] == h1
+
+
+def test_divergent_prefixes_diverge():
+    h1 = compute_block_hashes_for_seq([1] * 32, 16)
+    h2 = compute_block_hashes_for_seq([1] * 16 + [2] * 16, 16)
+    assert h1[0] == h2[0]
+    assert h1[1] != h2[1]
+
+
+def test_partial_blocks_ignored():
+    assert compute_block_hashes_for_seq([1, 2, 3], 4) == []
+
+
+def test_token_block_sequence_append_and_seal():
+    seq = TokenBlockSequence(block_size=4)
+    sealed = seq.extend([1, 2, 3])
+    assert sealed == [] and len(seq.blocks) == 0 and len(seq) == 3
+    block = seq.append(4)
+    assert block is not None
+    assert block.sequence_hash == compute_sequence_hash(None, [1, 2, 3, 4])
+    seq.extend([5, 6, 7, 8, 9])
+    assert len(seq.blocks) == 2
+    assert seq.partial_tokens == [9]
+    assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
+    assert seq.sequence_hashes() == compute_block_hashes_for_seq(seq.tokens(), 4)
+
+
+def test_token_block_sequence_matches_bulk_hashing():
+    tokens = list(range(100))
+    seq = TokenBlockSequence.from_tokens(tokens, 16)
+    assert seq.sequence_hashes() == compute_block_hashes_for_seq(tokens, 16)
+    assert seq.tokens() == tokens
+
+
+def test_truncate():
+    seq = TokenBlockSequence.from_tokens(list(range(40)), 8)
+    seq.truncate(20)
+    assert len(seq) == 20
+    assert seq.tokens() == list(range(20))
+    assert len(seq.blocks) == 2

@@ -1,0 +1,175 @@
+"""TCP request-push / response-stream transport: streaming, errors,
+cancellation, multiplexing (capability contract of ref pipeline/network/*)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import FnEngine
+from dynamo_tpu.runtime.transport import (
+    ERR_APP,
+    ERR_OVERLOADED,
+    ERR_UNAVAILABLE,
+    EngineError,
+    IngressServer,
+    TransportClient,
+)
+
+
+async def echo_engine(request, context):
+    for i in range(request["n"]):
+        yield {"i": i, "msg": request["msg"]}
+
+
+@pytest.fixture
+async def served():
+    server = IngressServer(FnEngine(echo_engine), host="127.0.0.1")
+    await server.start()
+    client = TransportClient()
+    yield server, client, f"127.0.0.1:{server.port}"
+    await client.close()
+    await server.stop()
+
+
+async def test_stream_roundtrip(served):
+    _, client, addr = served
+    out = [
+        item
+        async for item in client.generate(addr, {"n": 3, "msg": "hi"}, Context())
+    ]
+    assert out == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"}, {"i": 2, "msg": "hi"}]
+
+
+async def test_concurrent_multiplexed_streams(served):
+    _, client, addr = served
+
+    async def run(n):
+        return [
+            x["i"] async for x in client.generate(addr, {"n": n, "msg": "m"}, Context())
+        ]
+
+    results = await asyncio.gather(*(run(n) for n in (1, 5, 10, 2)))
+    assert results == [list(range(n)) for n in (1, 5, 10, 2)]
+
+
+async def test_application_error_propagates(served):
+    server, client, addr = served
+
+    async def failing(request, context):
+        yield {"ok": 1}
+        raise ValueError("boom")
+
+    server._engine = FnEngine(failing)
+    stream = client.generate(addr, {}, Context())
+    assert (await stream.__anext__()) == {"ok": 1}
+    with pytest.raises(EngineError) as exc_info:
+        await stream.__anext__()
+    assert exc_info.value.code == ERR_APP
+    assert "boom" in str(exc_info.value)
+
+
+async def test_connect_failure_is_retryable_error():
+    client = TransportClient()
+    with pytest.raises(EngineError) as exc_info:
+        async for _ in client.generate("127.0.0.1:1", {}, Context()):
+            pass
+    assert exc_info.value.code == ERR_UNAVAILABLE
+
+
+async def test_server_death_mid_stream_is_unavailable(served):
+    server, client, addr = served
+
+    async def slow(request, context):
+        yield {"i": 0}
+        await asyncio.sleep(30)
+        yield {"i": 1}
+
+    server._engine = FnEngine(slow)
+    stream = client.generate(addr, {}, Context())
+    assert (await stream.__anext__())["i"] == 0
+    await server.stop()
+    with pytest.raises(EngineError) as exc_info:
+        await asyncio.wait_for(stream.__anext__(), 5)
+    assert exc_info.value.code == ERR_UNAVAILABLE
+
+
+async def test_graceful_stop_drains_partial_results(served):
+    server, client, addr = served
+    started = asyncio.Event()
+
+    async def responsive(request, context):
+        yield {"i": 0}
+        started.set()
+        while not context.is_stopped():
+            await asyncio.sleep(0.01)
+        yield {"final": True}
+
+    server._engine = FnEngine(responsive)
+    ctx = Context()
+    stream = client.generate(addr, {}, ctx)
+    assert (await stream.__anext__()) == {"i": 0}
+    await started.wait()
+    ctx.stop_generating()
+    out = [item async for item in stream]
+    assert out == [{"final": True}]
+
+
+async def test_kill_abandons_stream(served):
+    server, client, addr = served
+    handler_killed = asyncio.Event()
+
+    async def endless(request, context):
+        try:
+            i = 0
+            while True:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.01)
+        finally:
+            if context.is_killed():
+                handler_killed.set()
+
+    server._engine = FnEngine(endless)
+    ctx = Context()
+    stream = client.generate(addr, {}, ctx)
+    assert (await stream.__anext__())["i"] == 0
+    ctx.kill()
+    out = [item async for item in stream]
+    assert len(out) <= 2  # nothing meaningful after kill
+    await asyncio.wait_for(handler_killed.wait(), 5)
+
+
+async def test_overload_rejection():
+    release = asyncio.Event()
+
+    async def blocker(request, context):
+        await release.wait()
+        yield {"done": True}
+
+    server = IngressServer(FnEngine(blocker), host="127.0.0.1", max_inflight=1)
+    await server.start()
+    client = TransportClient()
+    addr = f"127.0.0.1:{server.port}"
+    try:
+        first = client.generate(addr, {}, Context())
+        task = asyncio.create_task(first.__anext__())
+        await asyncio.sleep(0.1)  # let the first request take the slot
+        with pytest.raises(EngineError) as exc_info:
+            async for _ in client.generate(addr, {}, Context()):
+                pass
+        assert exc_info.value.code == ERR_OVERLOADED
+        release.set()
+        assert (await task) == {"done": True}
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_draining_rejects_new_requests(served):
+    server, client, addr = served
+    server.draining = True
+    with pytest.raises(EngineError) as exc_info:
+        async for _ in client.generate(addr, {"n": 1, "msg": "x"}, Context()):
+            pass
+    assert exc_info.value.code == ERR_UNAVAILABLE
