@@ -45,6 +45,7 @@ log = get_logger("store")
 
 DEFAULT_PORT = 3280
 _MAX_FRAME = 256 * 1024 * 1024
+_MAX_SUB_BUFFER = 8 * 1024 * 1024  # slow-subscriber disconnect threshold
 
 
 # ------------------------------- framing ---------------------------------
@@ -182,25 +183,57 @@ class StoreServer:
 
     # -- kv ops (single-threaded within the event loop => atomic) --
 
+    def _push_event(self, registry: Dict[int, _Watch], watch: _Watch,
+                    frame: dict) -> bool:
+        """Write an event frame to a watcher with backpressure protection.
+
+        Fan-out happens in sync code (no ``drain()``), so a slow consumer
+        would otherwise accumulate unbounded write buffers under event storms
+        (the KV-events subject is the hottest, ref: kv_router.rs:60). Policy:
+        if a subscriber's socket buffer exceeds the limit, close its
+        connection — the client observes the disconnect (None events) and can
+        resubscribe, the same slow-consumer contract NATS applies.
+        """
+        writer = watch.writer
+        if writer.is_closing():
+            registry.pop(watch.watch_id, None)
+            return False
+        if writer.transport.get_write_buffer_size() > _MAX_SUB_BUFFER:
+            log.warning("watch %d too slow (%d bytes buffered) — dropping conn",
+                        watch.watch_id, writer.transport.get_write_buffer_size())
+            registry.pop(watch.watch_id, None)
+            writer.close()
+            return False
+        try:
+            write_frame(writer, frame)
+            return True
+        except Exception:
+            registry.pop(watch.watch_id, None)
+            return False
+
     def _notify(self, event: str, key: str, value: Optional[bytes], rev: int) -> None:
         for watch in list(self._watches.values()):
             if key.startswith(watch.prefix):
-                try:
-                    write_frame(
-                        watch.writer,
-                        {
-                            "seq": None,
-                            "watch_id": watch.watch_id,
-                            "event": event,
-                            "key": key,
-                            "value": value,
-                            "rev": rev,
-                        },
-                    )
-                except Exception:
-                    self._watches.pop(watch.watch_id, None)
+                self._push_event(
+                    self._watches, watch,
+                    {
+                        "seq": None,
+                        "watch_id": watch.watch_id,
+                        "event": event,
+                        "key": key,
+                        "value": value,
+                        "rev": rev,
+                    },
+                )
 
     def _put(self, key: str, value: bytes, lease_id: int) -> int:
+        # validate the lease BEFORE mutating: a put under an expired/unknown
+        # lease must have no side effects (no orphan keys, no notifications)
+        lease = None
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise KeyError(f"unknown lease {lease_id}")
         self._revision += 1
         prev = self._kv.get(key)
         create_rev = prev.create_rev if prev else self._revision
@@ -209,10 +242,7 @@ class StoreServer:
             if old:
                 old.keys.discard(key)
         self._kv[key] = _KvEntry(value, lease_id, create_rev, self._revision)
-        if lease_id:
-            lease = self._leases.get(lease_id)
-            if lease is None:
-                raise KeyError(f"unknown lease {lease_id}")
+        if lease is not None:
             lease.keys.add(key)
         self._notify("put", key, value, self._revision)
         return self._revision
@@ -375,16 +405,13 @@ class StoreServer:
                 n = 0
                 for sub in list(self._subs.values()):
                     if subject.startswith(sub.prefix):
-                        try:
-                            write_frame(
-                                sub.writer,
-                                {"seq": None, "watch_id": sub.watch_id,
-                                 "event": "msg", "key": subject,
-                                 "value": payload, "rev": 0},
-                            )
+                        if self._push_event(
+                            self._subs, sub,
+                            {"seq": None, "watch_id": sub.watch_id,
+                             "event": "msg", "key": subject,
+                             "value": payload, "rev": 0},
+                        ):
                             n += 1
-                        except Exception:
-                            self._subs.pop(sub.watch_id, None)
                 return {"seq": seq, "ok": True, "delivered": n}
             if op == "q_push":
                 q = self._queues.setdefault(msg["queue"], _WorkQueue())
@@ -476,6 +503,10 @@ class StoreClient:
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watch_queues: Dict[int, asyncio.Queue] = {}
+        # events that raced ahead of watch registration (the server can push
+        # events for a fresh watch_id before the watch/subscribe response is
+        # processed by the caller); drained into the queue on registration
+        self._orphan_events: Dict[int, List[dict]] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_task: Optional[asyncio.Task] = None
         self.primary_lease: int = 0
@@ -543,9 +574,12 @@ class StoreClient:
                 return
             seq = msg.get("seq")
             if seq is None:
-                q = self._watch_queues.get(msg.get("watch_id"))
+                wid = msg.get("watch_id")
+                q = self._watch_queues.get(wid)
                 if q is not None:
                     q.put_nowait(msg)
+                elif wid is not None:
+                    self._orphan_events.setdefault(wid, []).append(msg)
             else:
                 fut = self._pending.pop(seq, None)
                 if fut and not fut.done():
@@ -654,10 +688,18 @@ class StoreClient:
         if not resp["ok"]:
             raise StoreError(resp.get("error", "watch failed"))
         watch_id = resp["watch_id"]
-        queue: asyncio.Queue = asyncio.Queue()
-        self._watch_queues[watch_id] = queue
+        queue = self._claim_watch_queue(watch_id)
         snapshot = [(k, v) for k, v, _l, _r in resp.get("kvs", [])]
         return snapshot, WatchStream(self, watch_id, queue)
+
+    def _claim_watch_queue(self, watch_id: int) -> asyncio.Queue:
+        """Register the event queue, draining any events that arrived between
+        the server creating the watch and the caller claiming it."""
+        queue: asyncio.Queue = asyncio.Queue()
+        for event in self._orphan_events.pop(watch_id, []):
+            queue.put_nowait(event)
+        self._watch_queues[watch_id] = queue
+        return queue
 
     # -- pub/sub (NATS-subject role) --
 
@@ -673,9 +715,9 @@ class StoreClient:
         if not resp["ok"]:
             raise StoreError(resp.get("error", "subscribe failed"))
         watch_id = resp["watch_id"]
-        queue: asyncio.Queue = asyncio.Queue()
-        self._watch_queues[watch_id] = queue
-        return WatchStream(self, watch_id, queue, kind="subscribe")
+        return WatchStream(
+            self, watch_id, self._claim_watch_queue(watch_id), kind="subscribe"
+        )
 
     # -- work queues (JetStream pull-consumer role, ref: nats.rs:426) --
 
@@ -755,6 +797,9 @@ class WatchStream:
             await self._client._call({"op": op, "watch_id": self.watch_id})
         except StoreError:
             pass
+        # events in flight between pop and the unwatch ack land in the orphan
+        # buffer; discard them so cancelled watches don't leak memory
+        self._client._orphan_events.pop(self.watch_id, None)
 
 
 def main() -> None:

@@ -67,7 +67,11 @@ class IngressServer:
         self._inflight: Dict[str, asyncio.Task] = {}
         self._contexts: Dict[str, Context] = {}
         self._conn_writers: set = set()
-        self._sem = asyncio.Semaphore(max_inflight) if max_inflight else None
+        # plain counter, not a Semaphore: admission check + increment happen
+        # atomically within one event-loop step, so there is no
+        # check-then-acquire race window between concurrent requests
+        self._max_inflight = max_inflight
+        self._active = 0
         self.draining = False
 
     async def start(self) -> None:
@@ -115,6 +119,7 @@ class IngressServer:
                         lambda _t, rid=rid: (
                             self._inflight.pop(rid, None),
                             self._contexts.pop(rid, None),
+                            conn_rids.discard(rid),
                         )
                     )
                 elif t == "cancel":
@@ -146,29 +151,35 @@ class IngressServer:
         self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock
     ) -> None:
         rid = msg["rid"]
-        headers = msg.get("headers") or {}
-        trace = None
-        if headers.get("traceparent"):
-            trace = TraceContext.parse(headers["traceparent"])
-        ctx = Context(request_id=headers.get("x-request-id") or rid, trace=trace)
-        self._contexts[rid] = ctx
 
         async def send(obj: dict) -> None:
             async with write_lock:
                 write_frame(writer, obj)
                 await writer.drain()
 
+        # admission control BEFORE any per-request state is registered: a
+        # rejected request must leave no context/accounting behind
         if self.draining:
             await send({"t": "err", "rid": rid, "error": "draining",
                         "code": ERR_UNAVAILABLE})
             return
-        if self._sem is not None and self._sem.locked():
+        if self._max_inflight is not None and self._active >= self._max_inflight:
             await send({"t": "err", "rid": rid, "error": "worker overloaded",
                         "code": ERR_OVERLOADED})
             return
-        if self._sem is not None:
-            await self._sem.acquire()
+        self._active += 1
+        ctx: Optional[Context] = None
         try:
+            headers = msg.get("headers") or {}
+            if not isinstance(headers, dict):
+                headers = {}
+            trace = None
+            tp = headers.get("traceparent")
+            if isinstance(tp, str):
+                trace = TraceContext.parse(tp)
+            ctx = Context(request_id=headers.get("x-request-id") or rid,
+                          trace=trace)
+            self._contexts[rid] = ctx
             request = msgpack.unpackb(msg["payload"], raw=False)
             async for item in self._engine.generate(request, ctx):
                 if ctx.is_killed():
@@ -182,7 +193,8 @@ class IngressServer:
         except asyncio.CancelledError:
             raise
         except (ConnectionResetError, BrokenPipeError):
-            ctx.kill()
+            if ctx is not None:
+                ctx.kill()
         except EngineError as exc:
             try:
                 await send({"t": "err", "rid": rid, "error": str(exc),
@@ -197,8 +209,7 @@ class IngressServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:
-            if self._sem is not None:
-                self._sem.release()
+            self._active -= 1
 
 
 class _Conn:
@@ -291,33 +302,42 @@ class TransportClient:
             conn.close()
             raise EngineError(f"worker {addr} send failed: {exc}", ERR_UNAVAILABLE)
 
+        # One long-lived watcher per stream injects a sentinel into the demux
+        # queue when cancellation fires, so the per-token hot loop below is a
+        # single queue.get() — no task creation per streamed item (the
+        # reference keeps this path equally lean, ref: tcp/client.rs).
+        _STOPPED = {"t": "_stopped"}
+
+        async def _watch_stop() -> None:
+            await context.wait_stopped()
+            queue.put_nowait(_STOPPED)
+            if not context.is_killed():
+                # stop → kill escalation mid-drain needs a second wakeup
+                await context.wait_killed()
+                queue.put_nowait(_STOPPED)
+
+        stop_task = asyncio.create_task(_watch_stop())
         cancel_sent = False
         try:
             while True:
-                get = asyncio.create_task(queue.get())
-                stop = asyncio.create_task(context.wait_stopped())
-                done, pending = await asyncio.wait(
-                    {get, stop}, return_when=asyncio.FIRST_COMPLETED
-                )
-                for p in pending:
-                    p.cancel()
-                if stop in done and get not in done:
-                    if not cancel_sent:
-                        cancel_sent = True
-                        await self._send_cancel(conn, rid, context.is_killed())
-                    if context.is_killed():
-                        return
-                    # graceful stop: keep draining until the worker ends the
-                    # stream (it emits the tokens generated so far)
-                    msg = await queue.get()
-                else:
-                    msg = get.result()
+                msg = await queue.get()
                 if msg is None:
                     raise EngineError(
                         f"worker {addr} connection dropped mid-stream",
                         ERR_UNAVAILABLE,
                     )
                 t = msg.get("t")
+                if t == "_stopped":
+                    if context.is_killed():
+                        cancel_sent = True
+                        await self._send_cancel(conn, rid, True)
+                        return
+                    if not cancel_sent:
+                        cancel_sent = True
+                        await self._send_cancel(conn, rid, False)
+                    # graceful stop: keep draining until the worker ends the
+                    # stream (it emits the tokens generated so far)
+                    continue
                 if t == "data":
                     yield msgpack.unpackb(msg["payload"], raw=False)
                 elif t == "end":
@@ -328,6 +348,7 @@ class TransportClient:
                         msg.get("code", ERR_APP),
                     )
         finally:
+            stop_task.cancel()
             conn.streams.pop(rid, None)
             if (context.is_stopped() or context.is_killed()) and not cancel_sent:
                 await self._send_cancel(conn, rid, context.is_killed())

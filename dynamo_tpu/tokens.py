@@ -3,9 +3,20 @@
 The canonical prefix-cache key math shared by the KV router's radix indexer,
 the engine's prefix cache, and the KV block manager. Capability-equivalent to
 the reference's standalone tokens crate (ref: lib/tokens/src/lib.rs:14-27 and
-lib/llm/src/tokens.rs:44,388,479); hashes are xxh3-64 with seed 1337 like the
-reference's ``compute_block_hash_for_seq`` (ref: lib/llm/src/kv_router/
-indexer.rs:53,125).
+lib/llm/src/tokens.rs:44,388,479).
+
+**Hash scheme (internally defined, framework-canonical).** The reference uses
+*two* schemes: router-side unchained per-block hashes
+(lib/llm/src/kv_router/indexer.rs:117-135) and KVBM-side chained sequence
+hashes over packed ``[parent_hash, block_hash]`` u64 pairs
+(lib/llm/src/tokens.rs:413-416). This build deliberately standardises on ONE
+scheme everywhere — router index, engine KV events, and KVBM block reuse all
+key on the same *chained sequence hash* so prefix matching and block reuse can
+never disagree across components. The chain is
+``xxh3_64(parent_seq_hash_le_u64 || token_bytes_u32_le, seed=1337)`` (root
+blocks hash their token bytes alone). Hash *values* therefore differ from the
+reference's; the seed (1337) and token byte encoding (u32 LE) match its
+conventions.
 
 Two hash kinds per block:
 - ``block_hash``: xxh3_64 over the block's own token bytes (u32 LE).
@@ -53,9 +64,10 @@ def compute_block_hashes_for_seq(
 ) -> list[SequenceHash]:
     """Sequence hashes for every *complete* block of ``tokens``.
 
-    This is the router-side hot path (ref: indexer.rs:125
-    ``compute_block_hash_for_seq``): only full blocks participate in prefix
-    matching; the ragged tail is ignored.
+    The router-side hot path (same role as the reference's
+    ``compute_block_hash_for_seq``, indexer.rs:125, but chained — see module
+    docstring): only full blocks participate in prefix matching; the ragged
+    tail is ignored.
     """
     out: list[SequenceHash] = []
     parent: Optional[SequenceHash] = None

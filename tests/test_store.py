@@ -173,3 +173,36 @@ async def test_leader_worker_barrier(store):
     assert sorted(p["rank"] for p in worker_payloads) == [0, 1, 2]
     for r in results[1:]:
         assert r == {"mesh": [2, 4]}
+
+
+async def test_put_with_dead_lease_has_no_side_effects(store):
+    """A put under an unknown/expired lease must fail without inserting the
+    key or notifying watchers (regression: orphan-key pollution)."""
+    c = await store()
+    watcher = await store()
+    _snapshot, stream = await watcher.watch_prefix("orphan/")
+    with pytest.raises(Exception):
+        await c.put("orphan/key", b"v", lease=999999)
+    assert await c.get("orphan/key") is None
+    # watcher saw nothing: a subsequent put is the FIRST event it sees
+    await c.put("orphan/marker", b"m")
+    event = await asyncio.wait_for(stream.next(), timeout=2)
+    assert event["key"] == "orphan/marker"
+    await stream.cancel()
+
+
+async def test_watch_catches_immediate_events(store):
+    """Events fired immediately after the watch response must not be lost
+    (regression: registration race dropped events for unclaimed watch ids)."""
+    c = await store()
+    writer_client = await store()
+    seen = []
+    for i in range(50):
+        key = f"race/{i}"
+        _snap, stream = await c.watch_prefix(key)
+        # fire the put from another connection as soon as the watch exists
+        await writer_client.put(key, b"x")
+        event = await asyncio.wait_for(stream.next(), timeout=2)
+        seen.append(event["key"])
+        await stream.cancel()
+    assert seen == [f"race/{i}" for i in range(50)]
