@@ -1,0 +1,20 @@
+"""The TPU-native model engine.
+
+The piece the reference outsources to vLLM/SGLang/TRT-LLM (ref: components/
+backends/vllm/src/dynamo/vllm/main.py:68 builds ``AsyncLLM``); this framework
+owns it. A JAX/XLA Llama-class model with a paged, HBM-resident KV cache, a
+continuous-batching scheduler with chunked prefill and prefix caching, and an
+asyncio engine loop that streams tokens per request while emitting KV events
+and forward-pass metrics for the router.
+"""
+
+from .config import EngineConfig, ModelConfig
+from .engine import InferenceEngine, Request, StepOutput
+
+__all__ = [
+    "EngineConfig",
+    "ModelConfig",
+    "InferenceEngine",
+    "Request",
+    "StepOutput",
+]

@@ -1,0 +1,105 @@
+"""Model + engine configuration.
+
+``ModelConfig`` describes a Llama-class decoder-only transformer (the shapes
+cover Llama 2/3 and TinyLlama-style test models). ``EngineConfig`` carries the
+serving-side knobs that the reference exposes through engine flags and the
+ModelRuntimeConfig (ref: lib/llm/src/local_model/runtime_config.rs:9 —
+``total_kv_blocks``, ``max_num_seqs``, ``max_num_batched_tokens``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-class decoder-only transformer shapes."""
+
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_position: int = 8192
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense). gpt-oss-class models set these.
+    num_experts: int = 0
+    num_experts_per_token: int = 0
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    # -- canned configs ---------------------------------------------------
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        return ModelConfig()
+
+    @staticmethod
+    def llama3_70b() -> "ModelConfig":
+        return ModelConfig(
+            hidden_size=8192, intermediate_size=28672, num_layers=80,
+            num_heads=64, num_kv_heads=8,
+        )
+
+    @staticmethod
+    def llama3_1b() -> "ModelConfig":
+        """Llama-3.2-1B shapes — fits one small chip comfortably."""
+        return ModelConfig(
+            hidden_size=2048, intermediate_size=8192, num_layers=16,
+            num_heads=32, num_kv_heads=8, head_dim=64,
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "ModelConfig":
+        """CPU-testable toy config (shapes divisible by an 8-way mesh)."""
+        return ModelConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=8, head_dim=8,
+            max_position=512, rope_theta=10000.0, dtype="float32",
+        )
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving-side engine knobs (vLLM-equivalent semantics)."""
+
+    block_size: int = 16                # tokens per KV block
+    num_blocks: int = 2048              # total KV blocks in HBM (G1 tier)
+    max_num_seqs: int = 64              # max concurrently running sequences
+    max_num_batched_tokens: int = 512   # per-step token budget (chunked prefill)
+    watermark: float = 0.01             # min free-block fraction before admit
+    max_model_len: int = 8192           # max tokens per sequence
+    enable_prefix_caching: bool = True
+    # decode batch sizes are padded up to the nearest bucket so XLA compiles
+    # a handful of programs, not one per batch size
+    decode_buckets: Tuple[int, ...] = (8, 16, 32, 64)
+    # prefill chunk lengths likewise bucketed (powers of two)
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
+    # sharding: (data, tensor) mesh axis sizes; (1, 1) = single chip
+    mesh_shape: Tuple[int, int] = (1, 1)
+
+    def __post_init__(self):
+        if self.max_num_seqs > max(self.decode_buckets):
+            raise ValueError("max_num_seqs exceeds largest decode bucket")
+        if self.max_num_batched_tokens > max(self.prefill_buckets):
+            raise ValueError(
+                "max_num_batched_tokens exceeds largest prefill bucket"
+            )
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.block_size - 1) // self.block_size

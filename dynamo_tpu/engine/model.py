@@ -1,0 +1,330 @@
+"""Llama-class transformer in functional JAX with a paged KV cache.
+
+This is the compute core the reference delegates to vLLM (ref: components/
+backends/vllm/src/dynamo/vllm/main.py:97 ``setup_vllm_engine``); here it is
+TPU-native. Design points:
+
+- **One unified step function** serves both prefill chunks and decode batches:
+  ``tokens [B, T]`` with per-sequence block tables. Prefill runs ``B=1`` with a
+  bucketed ``T``; decode runs ``T=1`` with a bucketed ``B``. XLA compiles one
+  program per (B, T, W) bucket combination.
+- **Layers are scanned** (``lax.scan`` over stacked parameters) so compile
+  time is O(1) in depth, and the KV cache is a single stacked array per K/V.
+- **Paged KV**: the cache is ``[L, num_blocks * block_size, KV, hd]``; the
+  step scatters the chunk's K/V into physical slots computed from the block
+  table, then gathers the sequence's blocks for attention. Physical block 0 is
+  a trash block — padding positions scatter there, and the allocator never
+  hands it out.
+- **TP via shardings, not code**: parameters and cache carry
+  ``jax.sharding.NamedSharding`` annotations over a ``("dp", "tp")`` mesh
+  (attention/MLP column-row sharded, KV heads sharded over tp); XLA GSPMD
+  inserts the all-reduces the reference gets from NCCL inside vLLM.
+- **Sampling is fused** into the step (greedy / temperature / top-k) so only
+  B sampled token ids cross the host boundary per step, not ``[B, vocab]``
+  logits.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import EngineConfig, ModelConfig
+
+Params = Dict[str, Any]
+Cache = Dict[str, jax.Array]
+
+
+# ------------------------------ init ------------------------------------
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-init parameters (stacked per-layer leaves for lax.scan)."""
+    dt = _dtype(cfg)
+    hd = cfg.head_dim_
+    D, H, KV, F, L, V = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
+    )
+    keys = jax.random.split(rng, 12)
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(dt)
+
+    params: Params = {
+        "embed": norm(keys[0], (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": norm(keys[1], (L, D, H * hd), D),
+            "wk": norm(keys[2], (L, D, KV * hd), D),
+            "wv": norm(keys[3], (L, D, KV * hd), D),
+            "wo": norm(keys[4], (L, H * hd, D), H * hd),
+            "mlp_norm": jnp.ones((L, D), dt),
+            "w_gate": norm(keys[5], (L, D, F), D),
+            "w_up": norm(keys[6], (L, D, F), D),
+            "w_down": norm(keys[7], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(keys[8], (D, V), D)
+    return params
+
+
+def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
+    """Paged KV cache: flat slot dimension = num_blocks * block_size."""
+    dt = _dtype(cfg)
+    slots = eng.num_blocks * eng.block_size
+    shape = (cfg.num_layers, slots, cfg.num_kv_heads, cfg.head_dim_)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+# ---------------------------- shardings ----------------------------------
+
+
+def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    dp, tp = shape
+    return Mesh(devices[: dp * tp].reshape(dp, tp), ("dp", "tp"))
+
+
+def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
+    """Megatron-style column/row TP over the ``tp`` mesh axis."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    shardings: Params = {
+        "embed": s(None, None),
+        "layers": {
+            "attn_norm": s(None, None),
+            "wq": s(None, None, "tp"),
+            "wk": s(None, None, "tp"),
+            "wv": s(None, None, "tp"),
+            "wo": s(None, "tp", None),
+            "mlp_norm": s(None, None),
+            "w_gate": s(None, None, "tp"),
+            "w_up": s(None, None, "tp"),
+            "w_down": s(None, "tp", None),
+        },
+        "final_norm": s(None),
+    }
+    if not cfg.tie_word_embeddings:
+        shardings["lm_head"] = s(None, "tp")
+    return shardings
+
+
+def cache_shardings(mesh: Mesh) -> Cache:
+    # KV heads sharded over tp so each shard holds the heads it computes
+    spec = NamedSharding(mesh, P(None, None, "tp", None))
+    return {"k": spec, "v": spec}
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    return jax.device_put(params, param_shardings(mesh, cfg))
+
+
+def shard_cache(cache: Cache, mesh: Mesh) -> Cache:
+    return jax.device_put(cache, cache_shardings(mesh))
+
+
+# ----------------------------- modules -----------------------------------
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * w.astype(jnp.float32)).astype(dt)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """HF-convention rotary embedding (rotate-half). x: [B, T, Hx, hd]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    pos = jnp.maximum(positions, 0).astype(jnp.float32)  # [B, T]
+    angles = pos[..., None] * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,        # [B, T, H, hd]
+    k_all: jax.Array,    # [B, S, KV, hd]  gathered sequence KV
+    v_all: jax.Array,    # [B, S, KV, hd]
+    positions: jax.Array,  # [B, T] absolute positions (-1 = pad)
+) -> jax.Array:
+    B, T, H, hd = q.shape
+    S, KV = k_all.shape[1], k_all.shape[2]
+    G = H // KV
+    qf = q.reshape(B, T, KV, G, hd).astype(jnp.float32)
+    kf = k_all.astype(jnp.float32)
+    vf = v_all.astype(jnp.float32)
+    scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / np.sqrt(hd)
+    # causal paged mask: key slot s corresponds to absolute position s
+    kpos = jnp.arange(S)[None, None, :]                  # [1, 1, S]
+    valid = kpos <= positions[:, :, None]                # [B, T, S]
+    scores = jnp.where(valid[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkgs,bskh->btkgh", probs, vf)
+    return out.reshape(B, T, H, hd).astype(q.dtype)
+
+
+def forward(
+    cfg: ModelConfig,
+    eng: EngineConfig,
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B, T] int32 (0 = pad)
+    positions: jax.Array,     # [B, T] int32 absolute, -1 = pad
+    block_tables: jax.Array,  # [B, W] int32 physical block ids (0 = trash)
+) -> Tuple[Cache, jax.Array]:
+    """Run the transformer over a token chunk, updating the paged cache.
+
+    Returns (updated cache, hidden states [B, T, D]).
+    """
+    B, T = tokens.shape
+    W = block_tables.shape[1]
+    bs = eng.block_size
+    hd = cfg.head_dim_
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+
+    h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+
+    # physical slot index per (b, t); pads go to the trash block (block 0)
+    pos_safe = jnp.maximum(positions, 0)
+    logical_block = pos_safe // bs                      # [B, T]
+    phys_block = jnp.take_along_axis(
+        block_tables, jnp.minimum(logical_block, W - 1), axis=1
+    )                                                   # [B, T]
+    slot = jnp.where(
+        positions >= 0, phys_block * bs + pos_safe % bs, 0
+    )                                                   # [B, T]
+
+    # flat gather indices for the sequence's whole context: [B, W*bs]
+    ctx_slots = (block_tables[:, :, None] * bs
+                 + jnp.arange(bs)[None, None, :]).reshape(B, W * bs)
+
+    def layer(carry, xs):
+        h, cache_k, cache_v = carry
+        p = xs  # this layer's stacked params + this layer's cache slice
+        lk, lv = p["cache_k"], p["cache_v"]   # [slots, KV, hd]
+
+        x = _rms_norm(h, p["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ p["wq"]).reshape(B, T, H, hd)
+        k = (x @ p["wk"]).reshape(B, T, KV, hd)
+        v = (x @ p["wv"]).reshape(B, T, KV, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        # scatter this chunk's K/V into the paged cache
+        lk = lk.at[slot.reshape(-1)].set(k.reshape(B * T, KV, hd))
+        lv = lv.at[slot.reshape(-1)].set(v.reshape(B * T, KV, hd))
+
+        # gather the full context for attention
+        k_all = jnp.take(lk, ctx_slots.reshape(-1), axis=0).reshape(
+            B, W * bs, KV, hd
+        )
+        v_all = jnp.take(lv, ctx_slots.reshape(-1), axis=0).reshape(
+            B, W * bs, KV, hd
+        )
+        attn = _attention(q, k_all, v_all, positions)
+        h = h + attn.reshape(B, T, H * hd) @ p["wo"]
+
+        x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
+        up = (x @ p["w_up"]).astype(jnp.float32)
+        h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+        return (h, cache_k, cache_v), (lk, lv)
+
+    # lax.scan over layers: stacked params zipped with per-layer cache slices
+    xs = dict(params["layers"])
+    xs["cache_k"] = cache["k"]
+    xs["cache_v"] = cache["v"]
+    (h, _, _), (new_k, new_v) = jax.lax.scan(
+        layer, (h, cache["k"], cache["v"]), xs
+    )
+    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return {"k": new_k, "v": new_v}, h
+
+
+def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    return (h.astype(jnp.float32) @ head.astype(jnp.float32))
+
+
+# ----------------------------- sampling ----------------------------------
+
+
+def sample(
+    logits: jax.Array,      # [B, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,  # [B] 0.0 = greedy
+    top_k: jax.Array,        # [B] 0 = disabled
+) -> jax.Array:
+    """Greedy / temperature / top-k sampling, vectorised over the batch."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    # top-k mask: keep logits >= k-th largest (k=0 disables)
+    safe_k = jnp.clip(top_k, 1, V)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
+    kth = jnp.take_along_axis(
+        sorted_logits, (safe_k - 1)[:, None], axis=-1
+    )                                                            # [B, 1]
+    masked = jnp.where(
+        (top_k[:, None] > 0) & (logits < kth), -jnp.inf, logits
+    )
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, masked / temp, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+# --------------------------- the step function ----------------------------
+
+
+def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
+    """Build the jitted unified prefill/decode step.
+
+    Signature:
+      step(params, cache, tokens[B,T], positions[B,T], block_tables[B,W],
+           last_idx[B], rng, temperature[B], top_k[B])
+        -> (cache, sampled[B])
+
+    ``last_idx[b]`` selects which chunk position's logits to sample (the last
+    valid token of the chunk). The cache is donated — XLA updates it in place.
+    """
+
+    def step(params, cache, tokens, positions, block_tables,
+             last_idx, rng, temperature, top_k):
+        cache, h = forward(
+            cfg, eng, params, cache, tokens, positions, block_tables
+        )
+        B = tokens.shape[0]
+        h_last = h[jnp.arange(B), last_idx]          # [B, D]
+        logits = logits_fn(cfg, params, h_last)      # [B, V]
+        sampled = sample(logits, rng, temperature, top_k)
+        return cache, sampled
+
+    jit_kwargs: Dict[str, Any] = {"donate_argnums": (1,)}
+    if mesh is not None:
+        # pin the data args replicated / batch-sharded; params+cache carry
+        # their own shardings from device_put
+        pass
+    return jax.jit(step, **jit_kwargs)

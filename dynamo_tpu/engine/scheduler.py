@@ -1,0 +1,458 @@
+"""Continuous-batching scheduler with paged-block accounting.
+
+Faithful to the vLLM semantics the reference encodes compactly in its mocker
+(ref: lib/llm/src/mocker/scheduler.rs:240 and kv_manager.rs:507): waiting and
+running queues, a per-step token budget with chunked prefill, a free-block
+watermark on admission, LRU eviction of sealed (hash-keyed) blocks, prefix
+caching by chained sequence hash, and preemption-by-recompute when the pool
+runs dry. KV events (stored/removed, ref: lib/llm/src/kv_router/
+protocols.rs) are emitted for the router's radix indexer.
+
+Token/KV invariants:
+- ``num_computed`` = tokens whose KV is written to the cache.
+- During prefill, chunks advance ``num_computed`` through the prompt; the
+  chunk that completes the prompt also samples the first output token.
+- During decode, the step feeds ``all_tokens[num_computed]`` (writing its KV)
+  and samples the next token, so ``total = num_computed + 1`` between steps.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Sequence as Seq, Tuple
+
+from ..tokens import TokenBlockSequence
+from ..utils.logging import get_logger
+from .config import EngineConfig
+
+log = get_logger("engine.scheduler")
+
+TRASH_BLOCK = 0  # physical block 0 absorbs padding writes; never allocated
+
+
+class KvEvent:
+    """KV cache event for the router indexer (stored / removed)."""
+
+    __slots__ = ("kind", "blocks")
+
+    def __init__(self, kind: str, blocks: List[dict]):
+        self.kind = kind      # "stored" | "removed" | "cleared"
+        self.blocks = blocks  # [{"seq_hash", "parent", "block_hash"}] / hashes
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "blocks": self.blocks}
+
+
+class BlockPool:
+    """Reference-counted physical block pool with hash-keyed reuse.
+
+    Sealed blocks (content-complete, keyed by chained sequence hash) become
+    *evictable* instead of free when their refcount drops to zero, forming the
+    prefix cache; eviction is LRU (ref: mocker/evictor.rs).
+    """
+
+    def __init__(self, num_blocks: int,
+                 on_event: Optional[Callable[[KvEvent], None]] = None):
+        self.num_blocks = num_blocks
+        self._free: Deque[int] = deque(range(1, num_blocks))  # 0 = trash
+        self._ref: Dict[int, int] = {}
+        self._hash_of: Dict[int, int] = {}         # block -> seq_hash
+        self._parent_of: Dict[int, Optional[int]] = {}
+        self._cached: Dict[int, int] = {}           # seq_hash -> block
+        self._evictable: "OrderedDict[int, int]" = OrderedDict()  # block -> hash
+        self.on_event = on_event
+
+    # -- capacity --
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return 1.0 - self.num_free / usable if usable else 1.0
+
+    # -- allocation --
+
+    def allocate(self) -> Optional[int]:
+        if self._free:
+            bid = self._free.popleft()
+            self._ref[bid] = 1
+            return bid
+        if self._evictable:
+            bid, seq_hash = self._evictable.popitem(last=False)  # LRU
+            self._cached.pop(seq_hash, None)
+            self._emit(KvEvent("removed", [seq_hash]))
+            self._hash_of.pop(bid, None)
+            self._parent_of.pop(bid, None)
+            self._ref[bid] = 1
+            return bid
+        return None
+
+    def lookup(self, seq_hash: int) -> Optional[int]:
+        """Prefix-cache hit: reuse a sealed block by sequence hash."""
+        bid = self._cached.get(seq_hash)
+        if bid is None:
+            return None
+        if bid in self._evictable:
+            del self._evictable[bid]
+            self._ref[bid] = 1
+        else:
+            self._ref[bid] += 1
+        return bid
+
+    def incref(self, bid: int) -> None:
+        self._ref[bid] += 1
+
+    def decref(self, bid: int) -> None:
+        self._ref[bid] -= 1
+        if self._ref[bid] > 0:
+            return
+        del self._ref[bid]
+        seq_hash = self._hash_of.get(bid)
+        if seq_hash is not None and self._cached.get(seq_hash) == bid:
+            self._evictable[bid] = seq_hash   # keep content for reuse
+        else:
+            self._free.append(bid)
+
+    def seal(self, bid: int, seq_hash: int, block_hash: int,
+             parent: Optional[int]) -> None:
+        """Register a content-complete block for prefix reuse."""
+        if seq_hash in self._cached:
+            return  # identical content already cached under another block
+        self._hash_of[bid] = seq_hash
+        self._parent_of[bid] = parent
+        self._cached[seq_hash] = bid
+        self._emit(KvEvent("stored", [
+            {"seq_hash": seq_hash, "block_hash": block_hash, "parent": parent}
+        ]))
+
+    def clear(self) -> None:
+        self._free = deque(range(1, self.num_blocks))
+        self._ref.clear()
+        self._hash_of.clear()
+        self._parent_of.clear()
+        self._cached.clear()
+        self._evictable.clear()
+        self._emit(KvEvent("cleared", []))
+
+    def _emit(self, event: KvEvent) -> None:
+        if self.on_event is not None:
+            self.on_event(event)
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class SchedSeq:
+    """Scheduler-side state of one sequence."""
+
+    seq_id: str
+    prompt_ids: List[int]
+    max_tokens: int
+    eos_token_ids: frozenset
+    temperature: float = 0.0
+    top_k: int = 0
+    arrival: float = field(default_factory=time.monotonic)
+    status: SeqStatus = SeqStatus.WAITING
+    output_ids: List[int] = field(default_factory=list)
+    block_table: List[int] = field(default_factory=list)
+    num_computed: int = 0
+    num_sealed_blocks: int = 0
+    finish_reason: Optional[str] = None
+    token_seq: Optional[TokenBlockSequence] = None
+    preemptions: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    def all_tokens(self) -> List[int]:
+        return self.prompt_ids + self.output_ids
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def prefill_done(self) -> bool:
+        # during decode the newest token's KV is always pending
+        return self.num_computed >= self.prompt_len
+
+
+@dataclass
+class PrefillChunk:
+    seq: SchedSeq
+    start: int  # first token index in this chunk
+    length: int
+
+    @property
+    def completes_prompt(self) -> bool:
+        # a chunk that reaches the end of *known* tokens transitions the
+        # sequence to decode (covers both fresh prompts and recompute after
+        # preemption, where outputs are re-prefilled too)
+        return self.start + self.length >= self.seq.total_tokens
+
+
+@dataclass
+class ScheduledBatch:
+    prefills: List[PrefillChunk] = field(default_factory=list)
+    decodes: List[SchedSeq] = field(default_factory=list)
+    preempted: List[SchedSeq] = field(default_factory=list)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.prefills and not self.decodes
+
+
+@dataclass
+class SchedulerStats:
+    """ForwardPassMetrics-equivalent snapshot (ref: kv_router/protocols.rs:48)."""
+
+    num_running: int = 0
+    num_waiting: int = 0
+    kv_usage: float = 0.0
+    num_total_blocks: int = 0
+    prefix_cache_hits: int = 0
+    prefix_cache_queries: int = 0
+
+
+class Scheduler:
+    """Admission + step planning over the block pool."""
+
+    def __init__(self, config: EngineConfig,
+                 on_event: Optional[Callable[[KvEvent], None]] = None):
+        self.config = config
+        self.pool = BlockPool(config.num_blocks, on_event=on_event)
+        self.waiting: Deque[SchedSeq] = deque()
+        self.running: List[SchedSeq] = []
+        self.stats = SchedulerStats(num_total_blocks=config.num_blocks - 1)
+
+    # -- admission --
+
+    def add(self, seq: SchedSeq) -> None:
+        seq.token_seq = TokenBlockSequence.from_tokens(
+            seq.prompt_ids, self.config.block_size
+        )
+        self.waiting.append(seq)
+
+    def abort(self, seq: SchedSeq, reason: str = "aborted") -> None:
+        if seq.status == SeqStatus.FINISHED:
+            return
+        self._finish(seq, reason)
+
+    # -- planning --
+
+    def schedule(self) -> ScheduledBatch:
+        batch = ScheduledBatch()
+        budget = self.config.max_num_batched_tokens
+        bs = self.config.block_size
+
+        # 1. decodes: every running sequence advances one token per step
+        for seq in list(self.running):
+            if budget <= 0:
+                break
+            if not self._ensure_slot(seq, seq.num_computed, batch):
+                continue  # seq itself was preempted
+            budget -= 1
+            batch.decodes.append(seq)
+
+        # 2. chunked prefill from the waiting queue, FIFO.  A prefill that
+        # completed admission already moved into self.running, so only count
+        # in-flight prefills that are NOT yet running to avoid double-counting
+        def active_seqs() -> int:
+            running_ids = {s.seq_id for s in self.running}
+            return len(self.running) + len(
+                {c.seq.seq_id for c in batch.prefills} - running_ids
+            )
+
+        while (self.waiting and budget > 0
+               and active_seqs() < self.config.max_num_seqs):
+            seq = self.waiting[0]
+            if seq.status == SeqStatus.WAITING:
+                self._match_prefix(seq)
+                seq.status = SeqStatus.PREFILL
+            target = seq.total_tokens  # prompt (+ outputs when recomputing)
+            remaining = target - seq.num_computed
+            # chunk ≤ budget, so a partial chunk always exhausts the budget
+            # and the loop cannot schedule the same token range twice
+            chunk = min(budget, remaining)
+            # blocks needed to hold [num_computed, num_computed + chunk)
+            have = len(seq.block_table)
+            need = (seq.num_computed + chunk + bs - 1) // bs - have
+            if not self._can_allocate(need):
+                # shrink the chunk to what fits above the watermark
+                chunk = self._max_affordable_chunk(seq, chunk)
+                if chunk <= 0:
+                    break  # pool exhausted; try again next step
+                need = (seq.num_computed + chunk + bs - 1) // bs - have
+            ok = True
+            for _ in range(need):
+                bid = self.pool.allocate()
+                if bid is None:
+                    ok = False
+                    break
+                seq.block_table.append(bid)
+            if not ok:
+                break
+            batch.prefills.append(
+                PrefillChunk(seq=seq, start=seq.num_computed, length=chunk)
+            )
+            budget -= chunk
+            if seq.num_computed + chunk >= target:
+                self.waiting.popleft()
+                self.running.append(seq)
+                seq.status = SeqStatus.RUNNING
+
+        self._refresh_stats()
+        return batch
+
+    # -- post-step bookkeeping (called by the engine executor) --
+
+    def on_prefill_executed(self, chunk: PrefillChunk,
+                            sampled: Optional[int]) -> None:
+        seq = chunk.seq
+        seq.num_computed += chunk.length
+        self._seal_complete_blocks(seq)
+        if chunk.completes_prompt and sampled is not None:
+            self._append_token(seq, sampled)
+
+    def on_decode_executed(self, seq: SchedSeq, sampled: int) -> None:
+        seq.num_computed += 1
+        self._seal_complete_blocks(seq)
+        self._append_token(seq, sampled)
+
+    def finish(self, seq: SchedSeq, reason: str) -> None:
+        self._finish(seq, reason)
+
+    def check_stop(self, seq: SchedSeq) -> Optional[str]:
+        if not seq.output_ids:
+            return None
+        last = seq.output_ids[-1]
+        if last in seq.eos_token_ids:
+            return "stop"
+        if len(seq.output_ids) >= seq.max_tokens:
+            return "length"
+        if seq.total_tokens >= self.config.max_model_len:
+            return "length"
+        return None
+
+    # -- internals --
+
+    def _append_token(self, seq: SchedSeq, token: int) -> None:
+        seq.output_ids.append(token)
+        assert seq.token_seq is not None
+        seq.token_seq.append(token)
+
+    def _seal_complete_blocks(self, seq: SchedSeq) -> None:
+        """Seal blocks whose KV is fully computed AND content-complete."""
+        assert seq.token_seq is not None
+        bs = self.config.block_size
+        computed_blocks = seq.num_computed // bs
+        sealable = min(computed_blocks, len(seq.token_seq.blocks))
+        for i in range(seq.num_sealed_blocks, sealable):
+            tb = seq.token_seq.blocks[i]
+            self.pool.seal(
+                seq.block_table[i], tb.sequence_hash, tb.block_hash,
+                tb.parent_sequence_hash,
+            )
+        seq.num_sealed_blocks = max(seq.num_sealed_blocks, sealable)
+
+    def _match_prefix(self, seq: SchedSeq) -> None:
+        """Prefix-cache lookup at admission (chained sequence hashes)."""
+        if not self.config.enable_prefix_caching or seq.num_computed:
+            return
+        assert seq.token_seq is not None
+        bs = self.config.block_size
+        # leave at least one token to compute so the step produces logits
+        max_match = (seq.total_tokens - 1) // bs
+        matched: List[int] = []
+        for i, tb in enumerate(seq.token_seq.blocks[:max_match]):
+            self.stats.prefix_cache_queries += 1
+            bid = self.pool.lookup(tb.sequence_hash)
+            if bid is None:
+                break
+            self.stats.prefix_cache_hits += 1
+            matched.append(bid)
+        seq.block_table = matched
+        seq.num_computed = len(matched) * bs
+        seq.num_sealed_blocks = len(matched)
+
+    def _ensure_slot(self, seq: SchedSeq, position: int,
+                     batch: ScheduledBatch) -> bool:
+        """Make sure a physical slot exists for ``position``; preempt the
+        lowest-priority sequence (LIFO) when the pool is dry."""
+        bs = self.config.block_size
+        needed_blocks = position // bs + 1
+        while len(seq.block_table) < needed_blocks:
+            bid = self.pool.allocate()
+            if bid is not None:
+                seq.block_table.append(bid)
+                continue
+            victim = self._pick_victim()
+            if victim is None or victim is seq:
+                self._preempt(seq, batch)
+                return False
+            self._preempt(victim, batch)
+            if victim in batch.decodes:
+                batch.decodes.remove(victim)
+        return True
+
+    def _pick_victim(self) -> Optional[SchedSeq]:
+        return self.running[-1] if self.running else None
+
+    def _preempt(self, seq: SchedSeq, batch: ScheduledBatch) -> None:
+        log.info("preempting seq %s (recompute)", seq.seq_id)
+        self._release_blocks(seq)
+        seq.num_computed = 0
+        seq.num_sealed_blocks = 0
+        seq.preemptions += 1
+        seq.status = SeqStatus.WAITING
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.appendleft(seq)
+        batch.preempted.append(seq)
+
+    def _release_blocks(self, seq: SchedSeq) -> None:
+        for bid in seq.block_table:
+            self.pool.decref(bid)
+        seq.block_table = []
+
+    def _finish(self, seq: SchedSeq, reason: str) -> None:
+        seq.status = SeqStatus.FINISHED
+        seq.finish_reason = reason
+        self._release_blocks(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        self._refresh_stats()
+
+    def _can_allocate(self, need: int) -> bool:
+        watermark_blocks = self.config.watermark * (self.config.num_blocks - 1)
+        return self.pool.num_free - need >= watermark_blocks
+
+    def _max_affordable_chunk(self, seq: SchedSeq, want: int) -> int:
+        bs = self.config.block_size
+        watermark_blocks = int(
+            self.config.watermark * (self.config.num_blocks - 1)
+        )
+        affordable = self.pool.num_free - watermark_blocks
+        if affordable <= 0:
+            return 0
+        have_capacity = len(seq.block_table) * bs - seq.num_computed
+        return min(want, have_capacity + affordable * bs)
+
+    def _refresh_stats(self) -> None:
+        self.stats.num_running = len(self.running)
+        self.stats.num_waiting = len(self.waiting)
+        self.stats.kv_usage = self.pool.usage

@@ -250,6 +250,23 @@ class Client:
 
     async def _watch_loop(self, stream) -> None:
         async for event in stream:
+            if event["event"] == "dropped":
+                # store shed this watch under backpressure — resubscribe with
+                # a fresh snapshot to resynchronise the instance table
+                log.warning("instance watch dropped — resubscribing")
+                snapshot, new_stream = await self.runtime.store.watch_prefix(
+                    self.endpoint.instance_prefix
+                )
+                live = {key: value for key, value in snapshot}
+                for instance_id, inst in list(self.instances.items()):
+                    if inst.key not in live:
+                        self._apply("delete", inst.key, None)
+                for key, value in live.items():
+                    self._apply("put", key, value)
+                self._watch_task = asyncio.create_task(
+                    self._watch_loop(new_stream)
+                )
+                return
             self._apply(event["event"], event["key"], event.get("value"))
 
     def instance_ids(self) -> List[int]:

@@ -45,7 +45,8 @@ log = get_logger("store")
 
 DEFAULT_PORT = 3280
 _MAX_FRAME = 256 * 1024 * 1024
-_MAX_SUB_BUFFER = 8 * 1024 * 1024  # slow-subscriber disconnect threshold
+_MAX_SUB_BUFFER = 8 * 1024 * 1024   # slow-subscriber drop threshold
+_MAX_ORPHAN_EVENTS = 256            # per unclaimed watch id
 
 
 # ------------------------------- framing ---------------------------------
@@ -190,19 +191,29 @@ class StoreServer:
         Fan-out happens in sync code (no ``drain()``), so a slow consumer
         would otherwise accumulate unbounded write buffers under event storms
         (the KV-events subject is the hottest, ref: kv_router.rs:60). Policy:
-        if a subscriber's socket buffer exceeds the limit, close its
-        connection — the client observes the disconnect (None events) and can
-        resubscribe, the same slow-consumer contract NATS applies.
+        when the connection's socket buffer exceeds the limit, unregister the
+        watch being written (under a storm that is the hot subject) and send
+        it a final small ``dropped`` event — the NATS slow-consumer contract.
+        The connection stays open: it also carries RPCs and the primary-lease
+        keepalive, so closing it would turn one slow subscription into a
+        spurious whole-worker death. Clients resubscribe on ``dropped``.
         """
         writer = watch.writer
         if writer.is_closing():
             registry.pop(watch.watch_id, None)
             return False
         if writer.transport.get_write_buffer_size() > _MAX_SUB_BUFFER:
-            log.warning("watch %d too slow (%d bytes buffered) — dropping conn",
-                        watch.watch_id, writer.transport.get_write_buffer_size())
+            log.warning(
+                "watch %d too slow (%d bytes buffered) — dropping watch",
+                watch.watch_id, writer.transport.get_write_buffer_size(),
+            )
             registry.pop(watch.watch_id, None)
-            writer.close()
+            try:
+                write_frame(writer, {"seq": None, "watch_id": watch.watch_id,
+                                     "event": "dropped", "key": watch.prefix,
+                                     "value": None, "rev": 0})
+            except Exception:
+                pass
             return False
         try:
             write_frame(writer, frame)
@@ -579,7 +590,13 @@ class StoreClient:
                 if q is not None:
                     q.put_nowait(msg)
                 elif wid is not None:
-                    self._orphan_events.setdefault(wid, []).append(msg)
+                    # bounded: an id that is never claimed (caller died between
+                    # the watch RPC and claiming) must not leak memory — past
+                    # the cap the whole id is dropped, same as pre-claim loss
+                    buf = self._orphan_events.setdefault(wid, [])
+                    buf.append(msg)
+                    if len(buf) > _MAX_ORPHAN_EVENTS:
+                        del self._orphan_events[wid]
             else:
                 fut = self._pending.pop(seq, None)
                 if fut and not fut.done():

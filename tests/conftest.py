@@ -1,9 +1,11 @@
 """Test harness config.
 
-Forces an 8-device virtual CPU mesh BEFORE jax import so multi-chip sharding
-logic is exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path; bench.py runs on the real chip). Async tests run under the
-anyio pytest plugin with the asyncio backend; coroutine tests are auto-marked.
+Forces an 8-device virtual CPU mesh so multi-chip sharding logic is exercised
+without TPU hardware (the driver separately dry-runs the multi-chip path;
+bench.py runs on the real chip). The axon sitecustomize pins
+``JAX_PLATFORMS=axon`` and registers the TPU plugin at interpreter startup, so
+the env var alone is not enough — ``jax.config.update`` wins. Async tests run
+under the anyio pytest plugin with the asyncio backend.
 """
 
 import os
@@ -15,9 +17,20 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def anyio_backend():
     return "asyncio"
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices("cpu")
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {devices}"
+    return devices
