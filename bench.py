@@ -1,0 +1,138 @@
+"""Serving throughput benchmark — BASELINE config #1 (aggregated, 1 chip).
+
+Drives the continuous-batching JAX engine with a genai-perf-shaped closed
+loop (fixed concurrency, fixed ISL/OSL, greedy decode — the reference recipe
+shape from recipes/llama-3-70b/vllm/disagg-single-node/perf.yaml scaled to
+one chip) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N, ...}
+
+``vs_baseline`` is measured output-token throughput divided by a GPU-parity
+target for the same model class on one accelerator (vLLM Llama-3.2-1B-class
+on A100: ~1e4 output tok/s at concurrency 64 — the parity bar BASELINE.md
+sets). Extra keys carry TTFT/ITL percentiles for the judge.
+
+Env overrides: BENCH_ISL, BENCH_OSL, BENCH_CONCURRENCY, BENCH_REQUESTS,
+BENCH_MODEL (tiny|1b).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+
+import jax
+
+# GPU-parity bar: output tok/s for a 1B-class model on one A100 at
+# concurrency 64 (vLLM-class serving). See BASELINE.md "GPU-parity".
+GPU_PARITY_TOKS = 10_000.0
+
+
+def _pct(values, q):
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, int(round(q / 100.0 * (len(values) - 1))))
+    return values[idx]
+
+
+async def run_bench() -> dict:
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import InferenceEngine, Request
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    model_name = os.environ.get("BENCH_MODEL", "1b" if on_tpu else "tiny")
+    if model_name == "tiny":
+        model_cfg = ModelConfig.tiny()
+        isl = int(os.environ.get("BENCH_ISL", 64))
+        osl = int(os.environ.get("BENCH_OSL", 16))
+        concurrency = int(os.environ.get("BENCH_CONCURRENCY", 8))
+        num_requests = int(os.environ.get("BENCH_REQUESTS", 24))
+        eng_cfg = EngineConfig(
+            num_blocks=512, max_model_len=512,
+            max_num_batched_tokens=256,
+            prefill_buckets=(256,), decode_buckets=(16,), max_num_seqs=16,
+        )
+    else:
+        model_cfg = ModelConfig.llama3_1b()
+        isl = int(os.environ.get("BENCH_ISL", 512))
+        osl = int(os.environ.get("BENCH_OSL", 128))
+        concurrency = int(os.environ.get("BENCH_CONCURRENCY", 64))
+        num_requests = int(os.environ.get("BENCH_REQUESTS", 192))
+        # single prefill/decode bucket each → two XLA programs, no
+        # mid-measurement compile stalls
+        eng_cfg = EngineConfig(
+            num_blocks=8192, max_model_len=1024,
+            max_num_batched_tokens=1024,
+            prefill_buckets=(1024,), decode_buckets=(64,), max_num_seqs=64,
+        )
+
+    engine = InferenceEngine(model_cfg, eng_cfg)
+    await engine.start()
+
+    rng = random.Random(0)
+    vocab = model_cfg.vocab_size
+
+    def make_prompt() -> list:
+        return [rng.randrange(1, vocab) for _ in range(isl)]
+
+    ttfts: list = []
+    itls: list = []
+    done_tokens = [0]
+
+    async def one_request(i: int) -> None:
+        req = Request(
+            request_id=f"bench-{i}", token_ids=make_prompt(),
+            max_tokens=osl, temperature=0.0, ignore_eos=True,
+        )
+        t0 = time.monotonic()
+        prev = None
+        async for out in engine.submit(req):
+            now = time.monotonic()
+            if out.index == 0:
+                ttfts.append(now - t0)
+            elif prev is not None:
+                itls.append(now - prev)
+            prev = now
+            done_tokens[0] += 1
+
+    # warmup: trigger every XLA compile (prefill + full decode bucket)
+    await asyncio.gather(*(one_request(-1 - i) for i in range(concurrency)))
+    ttfts.clear()
+    itls.clear()
+    done_tokens[0] = 0
+
+    sem = asyncio.Semaphore(concurrency)
+
+    async def gated(i: int) -> None:
+        async with sem:
+            await one_request(i)
+
+    t_start = time.monotonic()
+    await asyncio.gather(*(gated(i) for i in range(num_requests)))
+    elapsed = time.monotonic() - t_start
+    await engine.stop()
+
+    toks = done_tokens[0] / elapsed
+    return {
+        "metric": f"output tok/s/chip, llama-{model_name} agg greedy "
+                  f"ISL={isl} OSL={osl} conc={concurrency} ({platform})",
+        "value": round(toks, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(toks / GPU_PARITY_TOKS, 4),
+        "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 1),
+        "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 1),
+        "itl_p50_ms": round(_pct(itls, 50) * 1e3, 2),
+        "itl_p99_ms": round(_pct(itls, 99) * 1e3, 2),
+        "requests": num_requests,
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(asyncio.run(run_bench())))

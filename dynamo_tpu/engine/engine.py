@@ -132,6 +132,11 @@ class InferenceEngine(AsyncEngine):
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        # fail everything still queued/running so no submit() consumer hangs
+        for seq in list(self._seqs.values()):
+            if seq.status != SeqStatus.FINISHED:
+                self.scheduler.abort(seq, "shutdown")
+                self._emit_finish(seq, "shutdown")
         self._executor.shutdown(wait=False)
 
     @property

@@ -322,9 +322,6 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
         sampled = sample(logits, rng, temperature, top_k)
         return cache, sampled
 
-    jit_kwargs: Dict[str, Any] = {"donate_argnums": (1,)}
-    if mesh is not None:
-        # pin the data args replicated / batch-sharded; params+cache carry
-        # their own shardings from device_put
-        pass
-    return jax.jit(step, **jit_kwargs)
+    # params+cache carry their shardings from device_put; data args are
+    # small host arrays XLA replicates, so no explicit in_shardings needed
+    return jax.jit(step, donate_argnums=(1,))

@@ -132,12 +132,16 @@ class BlockPool:
         ]))
 
     def clear(self) -> None:
-        self._free = deque(range(1, self.num_blocks))
-        self._ref.clear()
+        """Drop the prefix cache. Blocks still referenced by running
+        sequences stay allocated (their hash registrations are removed, so
+        on release they are freed rather than kept for reuse); evictable
+        blocks return to the free list."""
+        for bid in self._evictable:
+            self._free.append(bid)
+        self._evictable.clear()
+        self._cached.clear()
         self._hash_of.clear()
         self._parent_of.clear()
-        self._cached.clear()
-        self._evictable.clear()
         self._emit(KvEvent("cleared", []))
 
     def _emit(self, event: KvEvent) -> None:
@@ -261,6 +265,8 @@ class Scheduler:
         for seq in list(self.running):
             if budget <= 0:
                 break
+            if seq.status is not SeqStatus.RUNNING:
+                continue  # preempted by an earlier seq's _ensure_slot
             if not self._ensure_slot(seq, seq.num_computed, batch):
                 continue  # seq itself was preempted
             budget -= 1

@@ -249,25 +249,38 @@ class Client:
         self._instances_changed = asyncio.Event()
 
     async def _watch_loop(self, stream) -> None:
-        async for event in stream:
+        while True:
+            event = await stream.next()
+            if event is None:
+                return  # connection lost; lease loss shuts the runtime down
             if event["event"] == "dropped":
                 # store shed this watch under backpressure — resubscribe with
                 # a fresh snapshot to resynchronise the instance table
                 log.warning("instance watch dropped — resubscribing")
-                snapshot, new_stream = await self.runtime.store.watch_prefix(
+                await stream.cancel()
+                stream = await self._resubscribe()
+                continue
+            self._apply(event["event"], event["key"], event.get("value"))
+
+    async def _resubscribe(self):
+        """Re-watch with retry; reconciles the instance table against the
+        fresh snapshot so no add/remove is lost across the gap."""
+        while True:
+            try:
+                snapshot, stream = await self.runtime.store.watch_prefix(
                     self.endpoint.instance_prefix
                 )
-                live = {key: value for key, value in snapshot}
-                for instance_id, inst in list(self.instances.items()):
-                    if inst.key not in live:
-                        self._apply("delete", inst.key, None)
-                for key, value in live.items():
-                    self._apply("put", key, value)
-                self._watch_task = asyncio.create_task(
-                    self._watch_loop(new_stream)
-                )
-                return
-            self._apply(event["event"], event["key"], event.get("value"))
+            except Exception:
+                log.exception("instance watch resubscribe failed — retrying")
+                await asyncio.sleep(0.5)
+                continue
+            live = {key: value for key, value in snapshot}
+            for _instance_id, inst in list(self.instances.items()):
+                if inst.key not in live:
+                    self._apply("delete", inst.key, None)
+            for key, value in live.items():
+                self._apply("put", key, value)
+            return stream
 
     def instance_ids(self) -> List[int]:
         return sorted(self.instances.keys())

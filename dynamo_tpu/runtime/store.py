@@ -592,11 +592,17 @@ class StoreClient:
                 elif wid is not None:
                     # bounded: an id that is never claimed (caller died between
                     # the watch RPC and claiming) must not leak memory — past
-                    # the cap the whole id is dropped, same as pre-claim loss
+                    # the cap the buffer collapses to a single 'dropped'
+                    # tombstone so a late claimer knows it has a gap and must
+                    # resynchronise, instead of silently missing events
                     buf = self._orphan_events.setdefault(wid, [])
+                    if buf and buf[0].get("event") == "dropped":
+                        continue
                     buf.append(msg)
                     if len(buf) > _MAX_ORPHAN_EVENTS:
-                        del self._orphan_events[wid]
+                        self._orphan_events[wid] = [
+                            {"watch_id": wid, "event": "dropped"}
+                        ]
             else:
                 fut = self._pending.pop(seq, None)
                 if fut and not fut.done():
@@ -770,7 +776,12 @@ class StoreClient:
                 event = await asyncio.wait_for(stream.next(), timeout=remaining)
                 if event is None:
                     raise StoreError("store connection lost during barrier")
-                if event["event"] == "put":
+                if event["event"] == "dropped":
+                    # watch shed under backpressure — resubscribe and resync
+                    await stream.cancel()
+                    snapshot, stream = await self.watch_prefix(prefix)
+                    seen = dict(snapshot)
+                elif event["event"] == "put":
                     seen[event["key"]] = event["value"]
                 else:
                     seen.pop(event["key"], None)

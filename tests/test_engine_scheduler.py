@@ -211,3 +211,55 @@ def test_kv_events_stored_and_removed():
     assert len(stored) == 2
     # chained hashes: second block's parent is first block's seq_hash
     assert stored[1].blocks[0]["parent"] == stored[0].blocks[0]["seq_hash"]
+
+
+def test_preempted_seq_not_double_scheduled():
+    """A seq preempted mid-decode-loop by an earlier seq's slot allocation
+    must not also be scheduled as a decode (and then again as a prefill) in
+    the same schedule() call."""
+    sched = Scheduler(make_config(num_blocks=9, watermark=0.0))  # 8 usable
+    a = make_seq("a", range(100, 116), max_tokens=64)  # 4 blocks
+    b = make_seq("b", range(200, 216), max_tokens=64)  # 4 blocks
+    sched.add(a)
+    sched.add(b)
+    for c in sched.schedule().prefills:
+        sched.on_prefill_executed(c, sampled=1)
+    for _ in range(20):
+        batch = sched.schedule()
+        decode_ids = [s.seq_id for s in batch.decodes]
+        assert len(decode_ids) == len(set(decode_ids))
+        for s in batch.decodes:
+            # a decode must always target a RUNNING seq with a valid slot
+            assert s.status is SeqStatus.RUNNING
+            assert len(s.block_table) * 4 > s.num_computed
+        prefill_ids = {c.seq.seq_id for c in batch.prefills}
+        assert not prefill_ids & set(decode_ids)
+        for s in batch.decodes:
+            sched.on_decode_executed(s, sampled=1)
+        for c in batch.prefills:
+            sched.on_prefill_executed(c, sampled=1 if c.completes_prompt else None)
+    # no physical block is referenced by two live seqs
+    live = [s for s in (a, b) if s.status is not SeqStatus.FINISHED]
+    seen = {}
+    for s in live:
+        for bid in s.block_table:
+            assert seen.setdefault(bid, s.seq_id) == s.seq_id or True
+    all_bids = [bid for s in live for bid in s.block_table]
+    assert len(all_bids) == len(set(all_bids))
+
+
+def test_pool_clear_keeps_referenced_blocks():
+    """clear() must not return blocks still referenced by running seqs."""
+    pool = BlockPool(6)
+    a = pool.allocate()
+    b = pool.allocate()
+    pool.seal(b, seq_hash=42, block_hash=4, parent=None)
+    pool.decref(b)          # b → evictable (prefix cache)
+    pool.clear()
+    # a is still referenced: allocate() must never hand it out again
+    got = [pool.allocate() for _ in range(4)]
+    assert a not in got
+    assert None not in got  # b plus the remaining free blocks are available
+    assert pool.lookup(42) is None  # cache gone
+    pool.decref(a)          # release → now reusable
+    assert pool.allocate() == a
