@@ -299,8 +299,8 @@ def sample(
 # --------------------------- the step function ----------------------------
 
 
-def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
-    """Build the jitted unified prefill/decode step.
+def raw_step_fn(cfg: ModelConfig, eng: EngineConfig):
+    """The unjitted unified prefill/decode step.
 
     Signature:
       step(params, cache, tokens[B,T], positions[B,T], block_tables[B,W],
@@ -308,7 +308,7 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
         -> (cache, sampled[B])
 
     ``last_idx[b]`` selects which chunk position's logits to sample (the last
-    valid token of the chunk). The cache is donated — XLA updates it in place.
+    valid token of the chunk).
     """
 
     def step(params, cache, tokens, positions, block_tables,
@@ -322,6 +322,12 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
         sampled = sample(logits, rng, temperature, top_k)
         return cache, sampled
 
-    # params+cache carry their shardings from device_put; data args are
-    # small host arrays XLA replicates, so no explicit in_shardings needed
-    return jax.jit(step, donate_argnums=(1,))
+    return step
+
+
+def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
+    """Jitted step with the cache donated — XLA updates it in place.
+
+    params+cache carry their shardings from device_put; data args are small
+    host arrays XLA replicates, so no explicit in_shardings are needed."""
+    return jax.jit(raw_step_fn(cfg, eng), donate_argnums=(1,))

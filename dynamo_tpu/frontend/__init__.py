@@ -1,0 +1,1 @@
+"""API frontends: OpenAI-compatible HTTP service (ref: lib/llm/src/http)."""

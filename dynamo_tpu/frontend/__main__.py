@@ -1,0 +1,100 @@
+"""Frontend process: HTTP service + model discovery in one process.
+
+Role-equivalent to the reference's ``python -m dynamo.frontend``
+(ref: components/frontend/src/dynamo/frontend/main.py): starts the OpenAI
+HTTP server, watches the store for registered models, and builds a routed
+pipeline per model as workers come and go.
+
+    python -m dynamo_tpu.frontend --port 8000 --router-mode round_robin
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from ..llm.discovery import ModelDeploymentCard, ModelWatcher
+from ..llm.entrypoint import build_routed_pipeline
+from ..runtime.component import DistributedRuntime
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+from .service import HttpService, ModelEntry, ModelManager
+
+log = get_logger("frontend")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument(
+        "--router-mode", default="round_robin",
+        choices=["round_robin", "random", "kv"],
+    )
+    return p.parse_args(argv)
+
+
+async def run_frontend(args: argparse.Namespace) -> None:
+    config = RuntimeConfig.from_settings()
+    if args.store_addr:
+        config.store_addr = args.store_addr
+    if args.namespace:
+        config.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(config)
+
+    manager = ModelManager()
+    service = HttpService(
+        manager, host=args.host, port=args.port, metrics=runtime.metrics,
+    )
+    clients = {}
+
+    async def on_add(card: ModelDeploymentCard, entry: dict) -> None:
+        endpoint = (
+            runtime.namespace(entry["namespace"])
+            .component(entry["component"]).endpoint(entry["endpoint"])
+        )
+        client = await endpoint.client()
+        clients[card.name] = client
+        engine = build_routed_pipeline(
+            card, client, router_mode=args.router_mode
+        )
+        manager.register(ModelEntry(
+            name=card.name, engine=engine,
+            chat="chat" in card.model_type,
+            completions="completions" in card.model_type,
+        ))
+
+    async def on_remove(name: str) -> None:
+        manager.remove(name)
+        client = clients.pop(name, None)
+        if client:
+            await client.stop()
+
+    watcher = ModelWatcher(runtime, on_add, on_remove)
+    await watcher.start()
+    await service.start()
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(
+            sig, lambda: asyncio.ensure_future(_shutdown())
+        )
+
+    async def _shutdown():
+        await watcher.stop()
+        await service.stop()
+        await runtime.shutdown()
+
+    log.info("frontend ready on %s:%d", args.host, service.port)
+    await runtime.shutdown_event.wait()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run_frontend(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+Role-equivalent to the reference's axum ``HttpService``
+(ref: lib/llm/src/http/service/service_v2.rs:125, openai.rs:209,439) with the
+same surface: ``/v1/chat/completions``, ``/v1/completions``, ``/v1/models``,
+health + Prometheus metrics, SSE streaming with aggregation for
+``stream=false``, and client-disconnect → context.kill propagation
+(ref: http/service/disconnect.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Dict, List, Optional
+
+from aiohttp import web
+
+from ..llm import openai as oai
+from ..llm.protocols import BackendOutput
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..runtime.transport import EngineError
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+
+log = get_logger("frontend.http")
+
+
+@dataclass
+class ModelEntry:
+    """A served model: its pipeline engine + capability flags
+    (ref: discovery/model_entry.rs:14, model_type.rs:33)."""
+
+    name: str
+    engine: AsyncEngine          # OpenAI dict in → BackendOutput stream out
+    chat: bool = True
+    completions: bool = True
+    created: int = field(default_factory=lambda: int(time.time()))
+    metadata: dict = field(default_factory=dict)
+
+
+class ModelManager:
+    """Name → entry registry the watcher populates dynamically
+    (ref: service_v2.rs:30 State/ModelManager)."""
+
+    def __init__(self):
+        self._models: Dict[str, ModelEntry] = {}
+
+    def register(self, entry: ModelEntry) -> None:
+        log.info("model registered: %s", entry.name)
+        self._models[entry.name] = entry
+
+    def remove(self, name: str) -> Optional[ModelEntry]:
+        entry = self._models.pop(name, None)
+        if entry:
+            log.info("model removed: %s", name)
+        return entry
+
+    def get(self, name: str) -> Optional[ModelEntry]:
+        return self._models.get(name)
+
+    def list(self) -> List[ModelEntry]:
+        return list(self._models.values())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._models
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = metrics or MetricsRegistry(prefix="dynamo_frontend")
+        m = self.metrics
+        self._m_requests = m.counter(
+            "http_requests_total", "HTTP requests", ["model", "endpoint", "status"]
+        )
+        self._m_inflight = m.gauge(
+            "http_inflight", "in-flight requests", ["model"]
+        )
+        self._m_ttft = m.histogram(
+            "ttft_seconds", "time to first token", ["model"]
+        )
+        self._m_itl = m.histogram(
+            "itl_seconds", "inter-token latency", ["model"]
+        )
+        self._m_duration = m.histogram(
+            "request_seconds", "request duration", ["model"]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes([
+            web.post("/v1/chat/completions", self._chat),
+            web.post("/v1/completions", self._completions),
+            web.get("/v1/models", self._models),
+            web.get("/health", self._health),
+            web.get("/live", self._live),
+            web.get("/metrics", self._metrics_route),
+        ])
+        return app
+
+    # ------------------------- lifecycle -------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve the ephemeral port
+        for s in self._runner.sites:
+            server = getattr(s, "_server", None)
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+        log.info("http frontend listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # --------------------------- routes --------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "healthy" if self.manager.list() else "no_models",
+            "models": [e.name for e in self.manager.list()],
+        })
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def _metrics_route(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.render(),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(oai.models_response(
+            [{"name": e.name, "created": e.created} for e in self.manager.list()]
+        ))
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="chat")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="completion")
+
+    # ------------------------ request flow ------------------------------
+
+    async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
+        endpoint = f"/v1/{'chat/completions' if kind == 'chat' else 'completions'}"
+        try:
+            body = await request.json()
+        except Exception:
+            return self._err(400, "invalid JSON body", "na", endpoint)
+        model = body.get("model", "")
+        try:
+            if kind == "chat":
+                oai.validate_chat_request(body)
+            else:
+                oai.validate_completion_request(body)
+        except oai.RequestError as e:
+            return self._err(400, str(e), model, endpoint)
+        entry = self.manager.get(model)
+        if entry is None:
+            return self._err(404, f"model {model!r} not found", model, endpoint)
+        if kind == "chat" and not entry.chat:
+            return self._err(400, f"model {model!r} does not support chat", model, endpoint)
+        if kind == "completion" and not entry.completions:
+            return self._err(400, f"{model!r} does not support completions", model, endpoint)
+
+        ctx = Context()
+        rid = oai.chat_id() if kind == "chat" else oai.completion_id()
+        stream_mode = bool(body.get("stream", False))
+        self._m_inflight.labels(model=model).inc()
+        t0 = time.monotonic()
+        try:
+            outputs = entry.engine.generate(body, ctx)
+            outputs = self._observe(outputs, model, t0)
+            if kind == "chat":
+                chunks = oai.chat_stream(outputs, rid, model)
+            else:
+                chunks = oai.completion_stream(outputs, rid, model)
+            if stream_mode:
+                return await self._sse(request, chunks, ctx, model, endpoint)
+            agg = (oai.aggregate_chat(chunks) if kind == "chat"
+                   else oai.aggregate_completion(chunks))
+            result = await agg
+            self._m_requests.labels(model=model, endpoint=endpoint, status="200").inc()
+            return web.json_response(result)
+        except EngineError as e:
+            code = 503 if e.code in ("unavailable", "overloaded") else 500
+            return self._err(code, str(e), model, endpoint)
+        except ValueError as e:
+            return self._err(400, str(e), model, endpoint)
+        except asyncio.CancelledError:
+            ctx.kill()
+            raise
+        except Exception:
+            log.exception("request %s failed", rid)
+            return self._err(500, "internal error", model, endpoint)
+        finally:
+            self._m_inflight.labels(model=model).dec()
+            self._m_duration.labels(model=model).observe(time.monotonic() - t0)
+
+    async def _sse(
+        self, request: web.Request, chunks: AsyncIterator[dict],
+        ctx: Context, model: str, endpoint: str,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "Connection": "keep-alive"},
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in chunks:
+                await resp.write(oai.sse_frame(chunk).encode())
+            await resp.write(oai.SSE_DONE.encode())
+            self._m_requests.labels(model=model, endpoint=endpoint, status="200").inc()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: kill the request so the worker frees the slot
+            # (ref: http/service/disconnect.rs)
+            log.info("client disconnected — killing request")
+            ctx.kill()
+            self._m_requests.labels(model=model, endpoint=endpoint, status="499").inc()
+        except EngineError as e:
+            # stream already started; emit an error frame then close
+            await resp.write(oai.sse_frame(
+                {"error": {"message": str(e), "code": e.code}}
+            ).encode())
+            self._m_requests.labels(model=model, endpoint=endpoint, status="503").inc()
+        with _suppress():
+            await resp.write_eof()
+        return resp
+
+    async def _observe(
+        self, outputs: AsyncIterator[BackendOutput], model: str, t0: float
+    ) -> AsyncIterator[BackendOutput]:
+        first = True
+        prev = None
+        async for out in outputs:
+            now = time.monotonic()
+            if first:
+                self._m_ttft.labels(model=model).observe(now - t0)
+                first = False
+            elif prev is not None:
+                self._m_itl.labels(model=model).observe(now - prev)
+            prev = now
+            yield out
+
+    def _err(self, status: int, msg: str, model: str, endpoint: str) -> web.Response:
+        self._m_requests.labels(
+            model=model, endpoint=endpoint, status=str(status)
+        ).inc()
+        return web.json_response(
+            {"error": {"message": msg, "type": "invalid_request_error"
+                       if status == 400 else "server_error"}},
+            status=status,
+        )
+
+
+class _suppress:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return True

@@ -1,0 +1,3 @@
+"""LLM pipeline layer: tokenization, preprocessing, backend post-processing,
+wire protocols, discovery — the TPU-native equivalent of the reference's
+``lib/llm`` (ref: lib/llm/src/lib.rs:13-44)."""

@@ -1,0 +1,59 @@
+"""Pipeline builder: wire frontend → preprocessor → backend → migration → router.
+
+Role-equivalent to the reference's ``build_routed_pipeline``
+(ref: lib/llm/src/entrypoint/input/common.rs:226,303-310). The returned
+engine accepts OpenAI request dicts and yields :class:`BackendOutput`s; the
+HTTP layer folds those into OpenAI SSE frames.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from ..runtime.component import Client
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine, link
+from .backend import Backend
+from .discovery import ModelDeploymentCard
+from .migration import Migration
+from .preprocessor import Preprocessor
+
+
+class PushSink(AsyncEngine):
+    """Routing sink over a component Client (ref: push_router.rs:33).
+
+    Modes: round_robin | random | direct:<instance_id>. KV-aware routing
+    plugs in as its own sink (see router/).
+    """
+
+    def __init__(self, client: Client, mode: str = "round_robin"):
+        self.client = client
+        self.mode = mode
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        if self.mode == "random":
+            return self.client.random(request, context)
+        if self.mode.startswith("direct:"):
+            return self.client.direct(
+                int(self.mode.split(":", 1)[1]), request, context
+            )
+        return self.client.round_robin(request, context)
+
+
+def build_routed_pipeline(
+    card: ModelDeploymentCard,
+    client: Client,
+    *,
+    router_mode: str = "round_robin",
+    sink: Optional[AsyncEngine] = None,
+) -> AsyncEngine:
+    """OpenAI dict in → BackendOutput stream out, over the cluster."""
+    tokenizer = card.load_tokenizer()
+    pre = Preprocessor(
+        tokenizer,
+        model_name=card.name,
+        max_context_len=card.context_length,
+    )
+    back = Backend(tokenizer)
+    inner = sink or PushSink(client, router_mode)
+    return link(pre, back, Migration(inner, card.migration_limit))

@@ -1,0 +1,78 @@
+"""Migration: retry a broken stream on a new worker with token carryover.
+
+Role-equivalent to the reference's ``Migration``/``RetryManager``
+(ref: lib/llm/src/migration.rs:26,88-190): when a worker dies mid-stream (or
+no worker is available at issue time), the request is re-issued to another
+instance with the tokens generated so far appended to the prompt, so
+generation continues seamlessly. Bounded by ``migration_limit`` from the
+model card.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..runtime.transport import EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE
+from ..utils.logging import get_logger
+
+log = get_logger("migration")
+
+RETRYABLE = (ERR_UNAVAILABLE, ERR_OVERLOADED)
+
+
+class Migration(AsyncEngine):
+    """Wraps the routing sink; retries with accumulated-token carryover."""
+
+    def __init__(self, sink: AsyncEngine, migration_limit: int = 3):
+        self.sink = sink
+        self.migration_limit = migration_limit
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[Any]:
+        req = dict(request)
+        orig_prompt_len = len(req.get("token_ids", []))
+        emitted: list = []
+        attempts_left = self.migration_limit
+        while True:
+            got_any_this_attempt = False
+            try:
+                async for item in self.sink.generate(req, context.child()):
+                    toks = list(item.get("token_ids", []))
+                    emitted.extend(toks)
+                    got_any_this_attempt = True
+                    # report the *original* prompt length even after
+                    # carryover re-issue (ref: migration.rs track_response)
+                    if item.get("num_prompt_tokens", 0) > orig_prompt_len:
+                        item = dict(item)
+                        item["num_prompt_tokens"] = orig_prompt_len
+                    yield item
+                    if item.get("finished"):
+                        return
+                # stream completed without a finished marker: treat as a
+                # worker drop unless the caller cancelled
+                if context.is_stopped():
+                    return
+                raise EngineError("stream ended early", ERR_UNAVAILABLE)
+            except EngineError as e:
+                if (e.code not in RETRYABLE or attempts_left <= 0
+                        or context.is_stopped()):
+                    raise
+                attempts_left -= 1
+                log.warning(
+                    "stream failed (%s); migrating with %d carried tokens "
+                    "(%d attempts left)", e.code, len(emitted), attempts_left,
+                )
+                req = dict(request)
+                req["token_ids"] = (
+                    list(request.get("token_ids", [])) + emitted
+                )
+                remaining = int(request.get("max_tokens", 64)) - len(emitted)
+                if remaining <= 0:
+                    return  # everything already generated
+                req["max_tokens"] = remaining
+                # re-issue loop continues; tiny guard against hot-looping on
+                # instantly-failing instances is the attempt bound itself
+                _ = got_any_this_attempt

@@ -1,0 +1,270 @@
+"""OpenAI wire protocol: response builders, SSE codec, delta aggregation.
+
+Role-equivalent to the reference's ``protocols/openai/*`` (chat/completions
+wire types, SSE codec at codec.rs, delta aggregators at aggregator.rs:691).
+Requests are accepted as plain dicts (validated), responses are built as
+dicts — msgpack/JSON-friendly and engine-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import AsyncIterator, Dict, List, Optional
+
+from .protocols import BackendOutput
+
+SSE_DONE = "data: [DONE]\n\n"
+
+
+class RequestError(ValueError):
+    """Client error → HTTP 400."""
+
+
+def validate_chat_request(req: dict) -> None:
+    if not isinstance(req.get("model"), str) or not req["model"]:
+        raise RequestError("'model' is required")
+    msgs = req.get("messages")
+    if not isinstance(msgs, list) or not msgs:
+        raise RequestError("'messages' must be a non-empty list")
+    for m in msgs:
+        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+            raise RequestError("each message needs 'role' and 'content'")
+    _validate_sampling(req)
+
+
+def validate_completion_request(req: dict) -> None:
+    if not isinstance(req.get("model"), str) or not req["model"]:
+        raise RequestError("'model' is required")
+    if "prompt" not in req:
+        raise RequestError("'prompt' is required")
+    _validate_sampling(req)
+
+
+def _validate_sampling(req: dict) -> None:
+    t = req.get("temperature")
+    if t is not None and not (0.0 <= float(t) <= 2.0):
+        raise RequestError("temperature must be in [0, 2]")
+    p = req.get("top_p")
+    if p is not None and not (0.0 < float(p) <= 1.0):
+        raise RequestError("top_p must be in (0, 1]")
+    mt = req.get("max_tokens") or req.get("max_completion_tokens")
+    if mt is not None and int(mt) < 1:
+        raise RequestError("max_tokens must be >= 1")
+    n = req.get("n")
+    if n is not None and int(n) != 1:
+        raise RequestError("only n=1 is supported")
+
+
+# ---------------------------- id helpers ----------------------------------
+
+
+def chat_id() -> str:
+    return f"chatcmpl-{uuid.uuid4().hex}"
+
+
+def completion_id() -> str:
+    return f"cmpl-{uuid.uuid4().hex}"
+
+
+# ------------------------- chunk construction ------------------------------
+
+
+def chat_chunk(
+    rid: str, model: str, created: int, *,
+    content: Optional[str] = None,
+    role: Optional[str] = None,
+    finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    delta: dict = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    out = {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "delta": delta, "finish_reason": finish_reason}
+        ],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def completion_chunk(
+    rid: str, model: str, created: int, *,
+    text: str = "",
+    finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    out = {
+        "id": rid,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "text": text, "finish_reason": finish_reason,
+             "logprobs": None}
+        ],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def _map_finish(reason: Optional[str]) -> Optional[str]:
+    # engine reasons → OpenAI finish_reason values
+    if reason in (None, "stop", "length"):
+        return reason
+    if reason == "cancelled":
+        return "stop"
+    return "stop" if reason else None
+
+
+# --------------------------- stream folding --------------------------------
+
+
+async def chat_stream(
+    outputs: AsyncIterator[BackendOutput], rid: str, model: str
+) -> AsyncIterator[dict]:
+    """Fold BackendOutputs into chat.completion.chunk frames."""
+    created = int(time.time())
+    yield chat_chunk(rid, model, created, role="assistant", content="")
+    prompt_tokens = 0
+    cum = 0
+    reason = "stop"
+    async for out in outputs:
+        prompt_tokens = out.num_prompt_tokens or prompt_tokens
+        cum = out.cum_tokens or cum
+        if out.finish_reason is not None:
+            reason = out.finish_reason
+            if out.text:
+                yield chat_chunk(rid, model, created, content=out.text)
+            break
+        if out.text:
+            yield chat_chunk(rid, model, created, content=out.text)
+    yield chat_chunk(
+        rid, model, created, finish_reason=_map_finish(reason) or "stop",
+        usage=usage_dict(prompt_tokens, cum),
+    )
+
+
+async def completion_stream(
+    outputs: AsyncIterator[BackendOutput], rid: str, model: str
+) -> AsyncIterator[dict]:
+    created = int(time.time())
+    prompt_tokens = 0
+    cum = 0
+    reason = "stop"
+    async for out in outputs:
+        prompt_tokens = out.num_prompt_tokens or prompt_tokens
+        cum = out.cum_tokens or cum
+        if out.finish_reason is not None:
+            reason = out.finish_reason
+            if out.text:
+                yield completion_chunk(rid, model, created, text=out.text)
+            break
+        if out.text:
+            yield completion_chunk(rid, model, created, text=out.text)
+    yield completion_chunk(
+        rid, model, created, finish_reason=_map_finish(reason) or "stop",
+        usage=usage_dict(prompt_tokens, cum),
+    )
+
+
+# ---------------------------- aggregation ----------------------------------
+
+
+async def aggregate_chat(chunks: AsyncIterator[dict]) -> dict:
+    """Collapse a chunk stream into one chat.completion response
+    (ref: aggregator.rs:691 — used for stream=false)."""
+    rid = model = ""
+    created = 0
+    text_parts: List[str] = []
+    role = "assistant"
+    finish = "stop"
+    usage = None
+    async for c in chunks:
+        rid, model, created = c["id"], c["model"], c["created"]
+        choice = c["choices"][0]
+        delta = choice.get("delta", {})
+        if delta.get("role"):
+            role = delta["role"]
+        if delta.get("content"):
+            text_parts.append(delta["content"])
+        if choice.get("finish_reason"):
+            finish = choice["finish_reason"]
+        if c.get("usage"):
+            usage = c["usage"]
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0,
+             "message": {"role": role, "content": "".join(text_parts)},
+             "finish_reason": finish}
+        ],
+        "usage": usage or usage_dict(0, 0),
+    }
+
+
+async def aggregate_completion(chunks: AsyncIterator[dict]) -> dict:
+    rid = model = ""
+    created = 0
+    text_parts: List[str] = []
+    finish = "stop"
+    usage = None
+    async for c in chunks:
+        rid, model, created = c["id"], c["model"], c["created"]
+        choice = c["choices"][0]
+        if choice.get("text"):
+            text_parts.append(choice["text"])
+        if choice.get("finish_reason"):
+            finish = choice["finish_reason"]
+        if c.get("usage"):
+            usage = c["usage"]
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "text": "".join(text_parts),
+             "finish_reason": finish, "logprobs": None}
+        ],
+        "usage": usage or usage_dict(0, 0),
+    }
+
+
+# ------------------------------- SSE ---------------------------------------
+
+
+def sse_frame(payload: dict) -> str:
+    return f"data: {json.dumps(payload, separators=(',', ':'))}\n\n"
+
+
+def models_response(models: List[dict]) -> dict:
+    return {
+        "object": "list",
+        "data": [
+            {"id": m["name"], "object": "model",
+             "created": m.get("created", 0), "owned_by": "dynamo-tpu"}
+            for m in models
+        ],
+    }

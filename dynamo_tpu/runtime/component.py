@@ -64,6 +64,10 @@ class DistributedRuntime:
         self.metrics = MetricsRegistry(prefix="dynamo")
         self.shutdown_event = asyncio.Event()
         self._ingress_servers: List[IngressServer] = []
+        self.system_server = None  # started when config.system_enabled
+        # (endpoint_path, store_key) pairs written by register_llm so
+        # graceful endpoint shutdown also deregisters the models
+        self.registered_models: List[tuple] = []
         store.on_lease_lost = self._on_lease_lost
 
     @staticmethod
@@ -74,7 +78,17 @@ class DistributedRuntime:
         store = await StoreClient.connect(
             config.store_addr, lease_ttl_s=config.lease_ttl_s
         )
-        return DistributedRuntime(store, config)
+        runtime = DistributedRuntime(store, config)
+        if config.system_enabled:
+            await runtime.start_system_server(port=config.system_port)
+        return runtime
+
+    async def start_system_server(self, port: int = 0) -> None:
+        """Start /health /live /metrics (ref: system_status_server.rs)."""
+        from .system_server import SystemServer
+
+        self.system_server = SystemServer(metrics=self.metrics, port=port)
+        await self.system_server.start()
 
     def _on_lease_lost(self) -> None:
         log.error("primary lease lost — shutting down runtime")
@@ -89,6 +103,9 @@ class DistributedRuntime:
 
     async def shutdown(self) -> None:
         self.shutdown_event.set()
+        if self.system_server is not None:
+            self.system_server.set_live(False)
+            await self.system_server.stop()
         for srv in self._ingress_servers:
             await srv.stop()
         await self.transport.close()
@@ -176,6 +193,12 @@ class Endpoint:
         )
         log.info("serving %s as instance %d at %s",
                  self.path, instance.instance_id, instance.addr)
+        if self.runtime.system_server is not None:
+            self.runtime.system_server.register_probe(
+                self.path,
+                lambda: {"healthy": not server.draining,
+                         "inflight": server.num_inflight},
+            )
         return ServedEndpoint(self, server, instance)
 
     async def client(self) -> "Client":
@@ -193,13 +216,24 @@ class ServedEndpoint:
     async def drain_and_stop(self) -> None:
         """Graceful shutdown: deregister, stop accepting, drain in-flight."""
         self.server.draining = True
-        await self.endpoint.runtime.store.delete(self.instance.key)
+        await self._deregister()
         await self.server.join()
         await self.server.stop()
 
     async def stop(self) -> None:
-        await self.endpoint.runtime.store.delete(self.instance.key)
+        await self._deregister()
         await self.server.stop()
+
+    async def _deregister(self) -> None:
+        runtime = self.endpoint.runtime
+        await runtime.store.delete(self.instance.key)
+        path = self.endpoint.path
+        if runtime.system_server is not None:
+            runtime.system_server.unregister_probe(path)
+        for ep_path, key in list(runtime.registered_models):
+            if ep_path == path:
+                await runtime.store.delete(key)
+                runtime.registered_models.remove((ep_path, key))
 
 
 class Client:

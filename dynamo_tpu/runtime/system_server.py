@@ -1,0 +1,93 @@
+"""System status server: /health, /live, /metrics for any runtime process.
+
+Role-equivalent to the reference's axum system server (ref: lib/runtime/src/
+system_status_server.rs, enabled by DYN_SYSTEM_ENABLED/PORT — here
+``DYNTPU_SYSTEM_ENABLED`` / ``DYNTPU_SYSTEM_PORT`` via RuntimeConfig). Health
+aggregates registered probe callbacks (engines, endpoints) so orchestrators
+can gate traffic on worker readiness.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from aiohttp import web
+
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+
+log = get_logger("system_server")
+
+HealthProbe = Callable[[], dict]   # () -> {"healthy": bool, ...detail}
+
+
+class SystemServer:
+    def __init__(
+        self,
+        metrics: Optional[MetricsRegistry] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.metrics = metrics
+        self.host = host
+        self.port = port
+        self._probes: Dict[str, HealthProbe] = {}
+        self._live = True
+        self._runner: Optional[web.AppRunner] = None
+
+    def register_probe(self, name: str, probe: HealthProbe) -> None:
+        self._probes[name] = probe
+
+    def unregister_probe(self, name: str) -> None:
+        self._probes.pop(name, None)
+
+    def set_live(self, live: bool) -> None:
+        self._live = live
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.add_routes([
+            web.get("/health", self._health),
+            web.get("/live", self._livez),
+            web.get("/metrics", self._metrics),
+        ])
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            server = getattr(s, "_server", None)
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+        log.info("system server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        detail = {}
+        healthy = True
+        for name, probe in self._probes.items():
+            try:
+                r = probe()
+            except Exception as e:  # a broken probe is an unhealthy probe
+                r = {"healthy": False, "error": str(e)}
+            detail[name] = r
+            healthy = healthy and bool(r.get("healthy", False))
+        status = 200 if healthy or not self._probes else 503
+        return web.json_response(
+            {"status": "healthy" if status == 200 else "unhealthy",
+             "probes": detail},
+            status=status,
+        )
+
+    async def _livez(self, request: web.Request) -> web.Response:
+        return web.json_response({"live": self._live},
+                                 status=200 if self._live else 503)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body = self.metrics.render() if self.metrics else b""
+        return web.Response(body=body, content_type="text/plain",
+                            charset="utf-8")

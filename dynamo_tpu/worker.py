@@ -1,0 +1,154 @@
+"""Engine worker process: build the JAX engine, serve it, register the model.
+
+Role-equivalent to the reference's backend worker mains (ref: components/
+backends/vllm/src/dynamo/vllm/main.py:184,325): create the runtime, start the
+inference engine, expose ``generate`` (+ ``clear_kv_blocks``) endpoints, and
+register the model so frontends discover it.
+
+    python -m dynamo_tpu.worker --model tiny --model-name demo \
+        --tokenizer /path/tokenizer.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+from typing import Optional
+
+from .engine.config import EngineConfig, ModelConfig
+from .engine.engine import InferenceEngine
+from .llm.discovery import ModelDeploymentCard, register_llm
+from .llm.tokenizer import Tokenizer
+from .runtime.component import DistributedRuntime
+from .utils.config import RuntimeConfig
+from .utils.logging import get_logger
+
+log = get_logger("worker")
+
+MODEL_PRESETS = {
+    "tiny": ModelConfig.tiny,
+    "1b": ModelConfig.llama3_1b,
+    "8b": ModelConfig.llama3_8b,
+    "70b": ModelConfig.llama3_70b,
+}
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu engine worker")
+    p.add_argument("--model", default="tiny", choices=sorted(MODEL_PRESETS))
+    p.add_argument("--model-name", default=None,
+                   help="served model name (default: preset name)")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer.json path or HF model dir")
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-batched-tokens", type=int, default=512)
+    p.add_argument("--max-model-len", type=int, default=8192)
+    p.add_argument("--mesh", default="1,1", help="dp,tp mesh axis sizes")
+    p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--advertise-host", default="127.0.0.1")
+    return p.parse_args(argv)
+
+
+def load_tokenizer(path: Optional[str]) -> Optional[Tokenizer]:
+    if path is None:
+        return None
+    import os
+
+    if os.path.isdir(path):
+        return Tokenizer.from_pretrained_dir(path)
+    return Tokenizer.from_file(path)
+
+
+async def run_worker(args: argparse.Namespace) -> None:
+    config = RuntimeConfig.from_settings()
+    if args.store_addr:
+        config.store_addr = args.store_addr
+    if args.namespace:
+        config.namespace = args.namespace
+
+    dp, tp = (int(x) for x in args.mesh.split(","))
+    model_cfg = MODEL_PRESETS[args.model]()
+    eng_cfg = EngineConfig(
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_num_seqs=args.max_num_seqs,
+        max_num_batched_tokens=args.max_batched_tokens,
+        max_model_len=min(args.max_model_len, model_cfg.max_position),
+        mesh_shape=(dp, tp),
+    )
+    tokenizer = load_tokenizer(args.tokenizer)
+    name = args.model_name or args.model
+
+    # Build the engine BEFORE taking the store lease: engine construction is
+    # seconds of synchronous JAX work (param init, device_put) that would
+    # starve the lease keepalive and get the worker evicted at birth.
+    engine = InferenceEngine(model_cfg, eng_cfg)
+    runtime = await DistributedRuntime.from_settings(config)
+    await engine.start()
+
+    endpoint = (runtime.namespace().component(args.component)
+                .endpoint(args.endpoint))
+    served = await endpoint.serve_endpoint(
+        engine, advertise_host=args.advertise_host,
+        metadata={"model": name},
+    )
+
+    async def clear_kv(request, context):
+        engine.clear_kv_blocks()
+        yield {"cleared": True}
+
+    clear_ep = (runtime.namespace().component(args.component)
+                .endpoint("clear_kv_blocks"))
+    await clear_ep.serve_endpoint(
+        clear_kv, advertise_host=args.advertise_host
+    )
+
+    if tokenizer is not None:
+        card = ModelDeploymentCard(
+            name=name,
+            tokenizer_json=tokenizer.to_json_str(),
+            chat_template=tokenizer.chat_template,
+            context_length=eng_cfg.max_model_len,
+            kv_block_size=eng_cfg.block_size,
+            migration_limit=args.migration_limit,
+            eos_token_ids=list(tokenizer.eos_token_ids),
+            bos_token_id=tokenizer.bos_token_id,
+            runtime_config={
+                "total_kv_blocks": eng_cfg.num_blocks,
+                "max_num_seqs": eng_cfg.max_num_seqs,
+                "max_num_batched_tokens": eng_cfg.max_num_batched_tokens,
+            },
+        )
+        await register_llm(endpoint, card)
+
+    loop = asyncio.get_running_loop()
+
+    def _graceful():
+        log.info("signal received — draining")
+        asyncio.ensure_future(_shutdown())
+
+    async def _shutdown():
+        await served.drain_and_stop()
+        await engine.stop()
+        await runtime.shutdown()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, _graceful)
+
+    log.info("worker ready: model=%s engine=%s", name, eng_cfg)
+    await runtime.shutdown_event.wait()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run_worker(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -240,10 +240,6 @@ def test_preempted_seq_not_double_scheduled():
             sched.on_prefill_executed(c, sampled=1 if c.completes_prompt else None)
     # no physical block is referenced by two live seqs
     live = [s for s in (a, b) if s.status is not SeqStatus.FINISHED]
-    seen = {}
-    for s in live:
-        for bid in s.block_table:
-            assert seen.setdefault(bid, s.seq_id) == s.seq_id or True
     all_bids = [bid for s in live for bid in s.block_table]
     assert len(all_bids) == len(set(all_bids))
 

@@ -1,0 +1,120 @@
+"""OpenAI HTTP frontend: routes, SSE streaming, aggregation, errors."""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.service import HttpService, ModelEntry, ModelManager
+from dynamo_tpu.llm.protocols import BackendOutput
+from dynamo_tpu.runtime.engine import FnEngine
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+
+def fake_engine(text_parts=("Hello", " world"), reason="stop"):
+    async def gen(request, context):
+        cum = 0
+        for i, part in enumerate(text_parts):
+            cum += 1
+            last = i == len(text_parts) - 1
+            yield BackendOutput(
+                token_ids=[i], text=part,
+                finish_reason=reason if last else None,
+                cum_tokens=cum, num_prompt_tokens=3,
+            )
+    return FnEngine(gen)
+
+
+@pytest.fixture
+async def service():
+    manager = ModelManager()
+    manager.register(ModelEntry(name="m1", engine=fake_engine()))
+    svc = HttpService(manager, host="127.0.0.1", port=0,
+                      metrics=MetricsRegistry(prefix="test_frontend"))
+    await svc.start()
+    yield svc
+    await svc.stop()
+
+
+def url(svc, path):
+    return f"http://127.0.0.1:{svc.port}{path}"
+
+
+CHAT_BODY = {"model": "m1", "messages": [{"role": "user", "content": "hi"}]}
+
+
+@pytest.mark.anyio
+async def test_chat_aggregated(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url(service, "/v1/chat/completions"), json=CHAT_BODY) as r:
+            assert r.status == 200
+            body = await r.json()
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["content"] == "Hello world"
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert body["usage"]["completion_tokens"] == 2
+    assert body["usage"]["prompt_tokens"] == 3
+
+
+@pytest.mark.anyio
+async def test_chat_streaming_sse(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            url(service, "/v1/chat/completions"),
+            json={**CHAT_BODY, "stream": True},
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await r.read()).decode()
+    frames = [ln[6:] for ln in raw.split("\n") if ln.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert text == "Hello world"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert chunks[-1]["usage"]["total_tokens"] == 5
+
+
+@pytest.mark.anyio
+async def test_completions(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            url(service, "/v1/completions"),
+            json={"model": "m1", "prompt": "abc"},
+        ) as r:
+            assert r.status == 200
+            body = await r.json()
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"] == "Hello world"
+
+
+@pytest.mark.anyio
+async def test_models_and_health(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url(service, "/v1/models")) as r:
+            models = await r.json()
+        async with s.get(url(service, "/health")) as r:
+            health = await r.json()
+        async with s.get(url(service, "/metrics")) as r:
+            metrics = await r.text()
+    assert models["data"][0]["id"] == "m1"
+    assert health["status"] == "healthy"
+    assert "test_frontend_http_requests_total" in metrics
+
+
+@pytest.mark.anyio
+async def test_validation_errors(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url(service, "/v1/chat/completions"),
+                          json={"model": "m1"}) as r:
+            assert r.status == 400
+        async with s.post(url(service, "/v1/chat/completions"),
+                          json={**CHAT_BODY, "model": "nope"}) as r:
+            assert r.status == 404
+        async with s.post(url(service, "/v1/chat/completions"),
+                          json={**CHAT_BODY, "temperature": 9}) as r:
+            assert r.status == 400
+        async with s.post(url(service, "/v1/chat/completions"),
+                          data=b"not json") as r:
+            assert r.status == 400

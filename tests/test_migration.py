@@ -1,0 +1,99 @@
+"""Migration operator: retry with token carryover (ref migration.rs:88-190)."""
+
+import pytest
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.transport import EngineError, ERR_APP, ERR_UNAVAILABLE
+
+
+class FlakyEngine(AsyncEngine):
+    """Streams tokens; dies with `code` after `fail_after` tokens, `fails` times."""
+
+    def __init__(self, fails=1, fail_after=3, code=ERR_UNAVAILABLE):
+        self.fails = fails
+        self.fail_after = fail_after
+        self.code = code
+        self.requests = []
+
+    async def generate(self, request, context):
+        self.requests.append(dict(request))
+        start = len(request["token_ids"])
+        n = int(request["max_tokens"])
+        for i in range(n):
+            if self.fails > 0 and i >= self.fail_after:
+                self.fails -= 1
+                raise EngineError("worker died", self.code)
+            yield {
+                "token_ids": [1000 + start + i],
+                "finished": i == n - 1,
+                "finish_reason": "length" if i == n - 1 else None,
+                "num_prompt_tokens": start,
+            }
+
+
+async def collect(engine, request, ctx=None):
+    out = []
+    async for item in engine.generate(request, ctx or Context()):
+        out.append(item)
+    return out
+
+
+@pytest.mark.anyio
+async def test_migration_carries_tokens():
+    flaky = FlakyEngine(fails=1, fail_after=3)
+    mig = Migration(flaky, migration_limit=2)
+    out = await collect(mig, {"token_ids": [1, 2], "max_tokens": 8})
+    toks = [t for o in out for t in o["token_ids"]]
+    assert len(toks) == 8
+    assert out[-1]["finished"]
+    # second attempt got the carried tokens appended and reduced budget
+    assert len(flaky.requests) == 2
+    r2 = flaky.requests[1]
+    assert r2["token_ids"] == [1, 2] + toks[:3]
+    assert r2["max_tokens"] == 5
+    # prompt length reported to the client stays the original
+    assert all(o["num_prompt_tokens"] == 2 for o in out)
+
+
+@pytest.mark.anyio
+async def test_migration_limit_exhausted():
+    flaky = FlakyEngine(fails=5, fail_after=1)
+    mig = Migration(flaky, migration_limit=2)
+    with pytest.raises(EngineError):
+        await collect(mig, {"token_ids": [1], "max_tokens": 10})
+    assert len(flaky.requests) == 3  # initial + 2 retries
+
+
+@pytest.mark.anyio
+async def test_migration_non_retryable_error_propagates():
+    flaky = FlakyEngine(fails=1, fail_after=0, code=ERR_APP)
+    mig = Migration(flaky, migration_limit=3)
+    with pytest.raises(EngineError):
+        await collect(mig, {"token_ids": [1], "max_tokens": 4})
+    assert len(flaky.requests) == 1
+
+
+@pytest.mark.anyio
+async def test_migration_no_retry_after_cancel():
+    class DropEngine(AsyncEngine):
+        def __init__(self):
+            self.calls = 0
+
+        async def generate(self, request, context):
+            self.calls += 1
+            yield {"token_ids": [1], "finished": False,
+                   "num_prompt_tokens": 1}
+            context.stop_generating()  # simulates client cancel upstream
+
+    # the outer context is what Migration consults
+    eng = DropEngine()
+    mig = Migration(eng, migration_limit=3)
+    ctx = Context()
+
+    out = []
+    async for item in mig.generate({"token_ids": [7], "max_tokens": 5}, ctx):
+        out.append(item)
+        ctx.stop_generating()
+    assert eng.calls == 1  # ended early but cancelled → no migration
