@@ -15,7 +15,7 @@ import asyncio
 import signal
 
 from ..llm.discovery import ModelDeploymentCard, ModelWatcher
-from ..llm.entrypoint import build_routed_pipeline
+from ..llm.entrypoint import build_routed_pipeline, make_kv_sink
 from ..runtime.component import DistributedRuntime
 from ..utils.config import RuntimeConfig
 from ..utils.logging import get_logger
@@ -50,6 +50,7 @@ async def run_frontend(args: argparse.Namespace) -> None:
         manager, host=args.host, port=args.port, metrics=runtime.metrics,
     )
     clients = {}
+    kv_routers = {}
 
     async def on_add(card: ModelDeploymentCard, entry: dict) -> None:
         endpoint = (
@@ -58,8 +59,11 @@ async def run_frontend(args: argparse.Namespace) -> None:
         )
         client = await endpoint.client()
         clients[card.name] = client
+        sink = None
+        if args.router_mode == "kv":
+            sink, kv_routers[card.name] = await make_kv_sink(card, client)
         engine = build_routed_pipeline(
-            card, client, router_mode=args.router_mode
+            card, client, router_mode=args.router_mode, sink=sink,
         )
         manager.register(ModelEntry(
             name=card.name, engine=engine,
@@ -69,6 +73,9 @@ async def run_frontend(args: argparse.Namespace) -> None:
 
     async def on_remove(name: str) -> None:
         manager.remove(name)
+        router = kv_routers.pop(name, None)
+        if router:
+            await router.stop()
         client = clients.pop(name, None)
         if client:
             await client.stop()

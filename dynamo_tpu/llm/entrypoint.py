@@ -57,3 +57,19 @@ def build_routed_pipeline(
     back = Backend(tokenizer)
     inner = sink or PushSink(client, router_mode)
     return link(pre, back, Migration(inner, card.migration_limit))
+
+
+async def make_kv_sink(
+    card: ModelDeploymentCard, client: Client, **router_kwargs
+):
+    """Build + start a KV-aware routing sink for ``build_routed_pipeline``
+    (ref: KvPushRouter kv_router.rs:423). Returns ``(sink, router)`` so the
+    caller can ``router.stop()`` at teardown."""
+    from ..router.kv_router import KvPushRouter, KvRouter
+
+    router = KvRouter(
+        client, client.endpoint.component,
+        block_size=card.kv_block_size, **router_kwargs,
+    )
+    await router.start()
+    return KvPushRouter(router), router

@@ -1,0 +1,252 @@
+"""The KV-aware router and its pipeline sink
+(ref: lib/llm/src/kv_router.rs:185 ``KvRouter``, :423 ``KvPushRouter``).
+
+``KvRouter`` owns the prefix indexer (event-fed, with the approximate
+fallback), the potential-load tracker, and the event subscription; the
+``KvPushRouter`` sink plugs into the LLM pipeline in place of the
+round-robin ``PushSink`` and performs route → push → track → free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, AsyncIterator, Dict, Optional
+
+import msgpack
+
+from ..runtime.component import Client, Component
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..runtime.transport import EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE
+from ..utils.logging import get_logger
+from ..tokens import compute_block_hashes_for_seq
+from .indexer import ApproxKvIndexer, KvIndexer, RouterEvent
+from .scheduler import KvRouterConfig, PotentialLoads, Selection, select_worker
+
+log = get_logger("kv_router")
+
+KV_EVENTS_SUBJECT = "kv_events"         # ref: kv_router.rs:60
+LOAD_METRICS_SUBJECT = "load_metrics"   # ref: kv_router.rs:57
+
+
+class KvRouter:
+    """Routing brain: indexer + scheduler + event subscription
+    (ref: kv_router.rs:185).
+
+    ``use_events=False`` selects the ApproxKvIndexer (approx.rs:165): the
+    router then learns prefix placement from its own decisions only.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        component: Component,
+        block_size: int,
+        config: Optional[KvRouterConfig] = None,
+        use_events: bool = True,
+        seed: Optional[int] = None,
+    ):
+        self.client = client
+        self.component = component
+        self.block_size = block_size
+        self.config = config or KvRouterConfig()
+        self.indexer = KvIndexer(block_size) if use_events else None
+        self.approx = None if use_events else ApproxKvIndexer(block_size)
+        self.loads = PotentialLoads(block_size)
+        # worker_id -> latest ForwardPassMetrics snapshot (kv_usage, queue
+        # depths) from the load_metrics subject; drives busy-threshold
+        # rejection (ref: push_router.rs:58-63)
+        self.worker_stats: Dict[int, dict] = {}
+        self._rng = random.Random(seed)
+        self._sub_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+        self._stream = None
+        self._stats_stream = None
+        client.on_instance_removed.append(self._on_worker_removed)
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        store = self.client.runtime.store
+        if self._stats_task is None:
+            self._stats_stream = await store.subscribe(
+                self.component.event_subject(LOAD_METRICS_SUBJECT)
+            )
+            self._stats_task = asyncio.create_task(
+                self._stats_loop(self._stats_stream)
+            )
+        if self.indexer is None or self._sub_task is not None:
+            return
+        self._stream = await store.subscribe(
+            self.component.event_subject(KV_EVENTS_SUBJECT)
+        )
+        self._sub_task = asyncio.create_task(self._event_loop(self._stream))
+
+    async def stop(self) -> None:
+        for task_attr, stream_attr in (
+            ("_sub_task", "_stream"), ("_stats_task", "_stats_stream"),
+        ):
+            task = getattr(self, task_attr)
+            if task is not None:
+                task.cancel()
+                setattr(self, task_attr, None)
+            stream = getattr(self, stream_attr)
+            if stream is not None:
+                try:
+                    await stream.cancel()
+                except Exception:
+                    pass
+                setattr(self, stream_attr, None)
+        try:
+            self.client.on_instance_removed.remove(self._on_worker_removed)
+        except ValueError:
+            pass
+
+    async def _resubscribe(self, subject: str):
+        store = self.client.runtime.store
+        while True:
+            try:
+                return await store.subscribe(subject)
+            except Exception:
+                log.exception("resubscribe %s failed — retrying", subject)
+                await asyncio.sleep(0.5)
+
+    async def _event_loop(self, stream) -> None:
+        subject = self.component.event_subject(KV_EVENTS_SUBJECT)
+        while True:
+            event = await stream.next()
+            if event is None or event["event"] == "dropped":
+                # the store unregisters a shed/closed subscription — our
+                # index may have missed events, so drop all state and
+                # resubscribe; routing decisions rebuild it organically
+                log.warning("kv_events subscription lost — resetting index")
+                for w in list(self.client.instances):
+                    self.indexer.clear_worker(w)
+                await stream.cancel()
+                stream = self._stream = await self._resubscribe(subject)
+                continue
+            if event["event"] != "msg":
+                continue
+            try:
+                payload = msgpack.unpackb(event["value"], raw=False)
+                self.indexer.apply_event(RouterEvent.from_dict(payload))
+            except Exception:
+                log.exception("bad kv event")
+
+    async def _stats_loop(self, stream) -> None:
+        subject = self.component.event_subject(LOAD_METRICS_SUBJECT)
+        while True:
+            event = await stream.next()
+            if event is None or event["event"] == "dropped":
+                await stream.cancel()
+                stream = self._stats_stream = await self._resubscribe(subject)
+                continue
+            if event["event"] != "msg":
+                continue
+            try:
+                snap = msgpack.unpackb(event["value"], raw=False)
+                self.worker_stats[int(snap["worker_id"])] = snap
+            except Exception:
+                log.exception("bad load metrics event")
+
+    def _on_worker_removed(self, worker_id: int) -> None:
+        if self.indexer is not None:
+            self.indexer.remove_worker(worker_id)
+        if self.approx is not None:
+            self.approx.remove_worker(worker_id)
+        self.loads.remove_worker(worker_id)
+        self.worker_stats.pop(worker_id, None)
+
+    # -- routing (ref: kv_router.rs:291 find_best_match) --
+
+    def find_best_match(
+        self,
+        request_id: str,
+        token_ids: list,
+        *,
+        overlap_weight: Optional[float] = None,
+        temperature: Optional[float] = None,
+    ) -> Selection:
+        workers = self.client.instance_ids()
+        if not workers:
+            raise EngineError(
+                f"no instances for {self.client.endpoint.path}",
+                ERR_UNAVAILABLE,
+            )
+        # busy-threshold rejection (ref: push_router.rs:58-63): drop workers
+        # whose published KV usage exceeds the threshold; if every worker is
+        # saturated, reject so the frontend returns 503 instead of queueing
+        if self.config.busy_threshold is not None:
+            free = [
+                w for w in workers
+                if self.worker_stats.get(w, {}).get("kv_usage", 0.0)
+                < self.config.busy_threshold
+            ]
+            if not free:
+                raise EngineError(
+                    f"all {len(workers)} workers above busy threshold "
+                    f"{self.config.busy_threshold}", ERR_OVERLOADED,
+                )
+            workers = free
+        hashes = compute_block_hashes_for_seq(token_ids, self.block_size)
+        if self.indexer is not None:
+            overlaps = self.indexer.find_matches(hashes).scores
+        else:
+            overlaps = self.approx.find_matches_for_tokens(token_ids).scores
+        sel = select_worker(
+            workers, len(token_ids), overlaps, self.loads, self.block_size,
+            self.config, overlap_weight=overlap_weight,
+            temperature=temperature, rng=self._rng,
+        )
+        self.loads.add(request_id, sel.worker_id, len(token_ids),
+                       sel.overlap_blocks)
+        if self.approx is not None:
+            self.approx.record_routing_decision(sel.worker_id, token_ids)
+        log.debug(
+            "selected worker %d logit=%.3f overlap=%d blocks",
+            sel.worker_id, sel.logit, sel.overlap_blocks,
+        )
+        return sel
+
+    def prefill_done(self, request_id: str) -> None:
+        self.loads.prefill_done(request_id)
+
+    def free(self, request_id: str) -> None:
+        self.loads.free(request_id)
+
+
+class KvPushRouter(AsyncEngine):
+    """Pipeline sink: KV-aware route + direct push (ref: kv_router.rs:423).
+
+    Accepts the preprocessed wire dict (``token_ids`` present), picks the
+    worker via :class:`KvRouter`, streams from it, and maintains the
+    potential-load lifecycle (prefill→decode on first item, free at end).
+    Per-request ``router_hints`` override weight/temperature
+    (ref: RouterConfigOverride kv_router.rs:87-93).
+    """
+
+    def __init__(self, router: KvRouter):
+        self.router = router
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[Any]:
+        token_ids = list(request.get("token_ids", ()))
+        hints: Dict[str, Any] = request.get("router_hints") or {}
+        sel = self.router.find_best_match(
+            context.id, token_ids,
+            overlap_weight=hints.get("overlap_score_weight"),
+            temperature=hints.get("router_temperature"),
+        )
+        first = True
+        try:
+            async for item in self.router.client.direct(
+                sel.worker_id, request, context
+            ):
+                if first:
+                    self.router.prefill_done(context.id)
+                    first = False
+                yield item
+        finally:
+            self.router.free(context.id)

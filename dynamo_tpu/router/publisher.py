@@ -1,0 +1,138 @@
+"""Worker-side publishers: KV events + load metrics
+(ref: lib/llm/src/kv_router/publisher.rs:90,483).
+
+The reference relays engine ZMQ events onto NATS; our engine is in-process,
+so the publisher is just the engine's ``kv_event_sink`` batching onto the
+store's pub/sub. ``WorkerMetricsPublisher`` periodically publishes the
+ForwardPassMetrics-equivalent scheduler stats for the metrics aggregator and
+busy-threshold routing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+import msgpack
+
+from ..runtime.component import Component
+from ..utils.logging import get_logger
+from .indexer import RouterEvent
+from .kv_router import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
+
+log = get_logger("kv_publisher")
+
+
+class KvEventPublisher:
+    """Batches engine KV events onto the component's ``kv_events`` subject.
+
+    Wire format: msgpack ``{"worker_id": int, "event": {kind, blocks}}`` —
+    one message per engine event batch, preserving order.
+    """
+
+    def __init__(self, component: Component, worker_id: int):
+        self.component = component
+        self.worker_id = worker_id
+        self.subject = component.event_subject(KV_EVENTS_SUBJECT)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self.events_published = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def sink(self, event: dict) -> None:
+        """Engine-facing callback (``InferenceEngine.kv_event_sink``)."""
+        self._queue.put_nowait(event)
+
+    async def _pump(self) -> None:
+        store = self.component.runtime.store
+        while True:
+            events = [await self._queue.get()]
+            while True:  # drain: a prefill seals many blocks per step
+                try:
+                    events.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            try:
+                for payload in self._coalesce(events):
+                    await store.publish(
+                        self.subject + str(self.worker_id),
+                        msgpack.packb(payload, use_bin_type=True),
+                    )
+                    self.events_published += 1
+            except Exception:
+                log.exception("kv event publish failed")
+
+    def _coalesce(self, events: List[dict]) -> List[dict]:
+        """Merge runs of same-kind events into single wire messages (the
+        blocks field is already a list), preserving order across kinds."""
+        out: List[dict] = []
+        for event in events:
+            kind = event.get("kind")
+            blocks = tuple(event.get("blocks", ()))
+            if kind is None:
+                log.warning("malformed kv event (no kind): %r", event)
+                continue
+            if out and out[-1]["event"]["kind"] == kind and kind != "cleared":
+                out[-1]["event"]["blocks"].extend(blocks)
+            else:
+                out.append(RouterEvent(
+                    worker_id=self.worker_id, kind=kind, blocks=blocks,
+                ).to_dict())
+        return out
+
+
+class WorkerMetricsPublisher:
+    """Publishes ForwardPassMetrics-equivalent stats every ``interval_s``
+    (ref: publisher.rs:483; protocols.rs:48 ``ForwardPassMetrics``)."""
+
+    def __init__(
+        self, component: Component, worker_id: int, stats_fn,
+        interval_s: float = 1.0,
+    ):
+        self.component = component
+        self.worker_id = worker_id
+        self.stats_fn = stats_fn      # () -> SchedulerStats
+        self.interval_s = interval_s
+        self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def snapshot(self) -> dict:
+        s = self.stats_fn()
+        return {
+            "worker_id": self.worker_id,
+            "num_requests_running": s.num_running,
+            "num_requests_waiting": s.num_waiting,
+            "kv_usage": s.kv_usage,
+            "num_total_blocks": s.num_total_blocks,
+            "prefix_cache_hits": s.prefix_cache_hits,
+            "prefix_cache_queries": s.prefix_cache_queries,
+        }
+
+    async def _pump(self) -> None:
+        store = self.component.runtime.store
+        while True:
+            try:
+                await store.publish(
+                    self.subject + str(self.worker_id),
+                    msgpack.packb(self.snapshot(), use_bin_type=True),
+                )
+            except Exception:
+                log.exception("load metrics publish failed")
+            await asyncio.sleep(self.interval_s)

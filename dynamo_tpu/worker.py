@@ -100,6 +100,18 @@ async def run_worker(args: argparse.Namespace) -> None:
         metadata={"model": name},
     )
 
+    # KV events + load metrics for the KV-aware router / aggregator
+    # (ref: publisher.rs; the in-process seam replaces the ZMQ relay)
+    from .router.publisher import KvEventPublisher, WorkerMetricsPublisher
+
+    kv_pub = KvEventPublisher(endpoint.component, runtime.primary_lease)
+    kv_pub.start()
+    engine.kv_event_sink = kv_pub.sink
+    metrics_pub = WorkerMetricsPublisher(
+        endpoint.component, runtime.primary_lease, lambda: engine.stats
+    )
+    metrics_pub.start()
+
     async def clear_kv(request, context):
         engine.clear_kv_blocks()
         yield {"cleared": True}
@@ -136,6 +148,8 @@ async def run_worker(args: argparse.Namespace) -> None:
 
     async def _shutdown():
         await served.drain_and_stop()
+        await kv_pub.stop()
+        await metrics_pub.stop()
         await engine.stop()
         await runtime.shutdown()
 

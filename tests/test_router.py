@@ -1,0 +1,310 @@
+"""KV-aware router: indexer, cost-function scheduler, and e2e prefix
+affinity over real engines + the store's event plane
+(ref scenarios: lib/llm/src/kv_router/{indexer,scheduler}.rs tests)."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.router.indexer import (
+    ApproxKvIndexer, KvIndexer, RouterEvent,
+)
+from dynamo_tpu.router.scheduler import (
+    KvRouterConfig, PotentialLoads, select_worker, softmax_sample,
+)
+from dynamo_tpu.router.kv_router import KvRouter
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.tokens import compute_block_hashes_for_seq
+from dynamo_tpu.utils.config import RuntimeConfig
+
+BS = 4  # block size for unit tests
+
+
+def stored(worker, hashes):
+    return RouterEvent(
+        worker_id=worker, kind="stored",
+        blocks=tuple({"seq_hash": h} for h in hashes),
+    )
+
+
+def hashes_for(tokens):
+    return compute_block_hashes_for_seq(tokens, BS)
+
+
+# ------------------------------ indexer -------------------------------
+
+
+def test_indexer_prefix_match_depth():
+    idx = KvIndexer(BS)
+    toks = list(range(16))  # 4 blocks
+    h = hashes_for(toks)
+    idx.apply_event(stored(1, h[:2]))   # worker 1 holds 2 blocks
+    idx.apply_event(stored(2, h[:4]))   # worker 2 holds all 4
+    scores = idx.find_matches(h).scores
+    assert scores == {1: 2, 2: 4}
+
+
+def test_indexer_no_skip_matching():
+    """A worker holding block 2 but not block 1 must score 0 — prefix
+    matching never skips."""
+    idx = KvIndexer(BS)
+    h = hashes_for(list(range(12)))
+    idx.apply_event(stored(1, [h[1]]))  # holds only the middle block
+    assert idx.find_matches(h).scores.get(1) is None
+
+
+def test_indexer_removed_and_cleared():
+    idx = KvIndexer(BS)
+    h = hashes_for(list(range(12)))
+    idx.apply_event(stored(1, h))
+    idx.apply_event(stored(2, h))
+    idx.apply_event(RouterEvent(worker_id=1, kind="removed",
+                                blocks=(h[2],)))
+    assert idx.find_matches(h).scores == {1: 2, 2: 3}
+    idx.apply_event(RouterEvent(worker_id=2, kind="cleared", blocks=()))
+    assert idx.find_matches(h).scores == {1: 2}
+    idx.remove_worker(1)
+    assert idx.find_matches(h).scores == {}
+    assert idx.num_blocks() == 0
+
+
+def test_indexer_dump_events_roundtrip():
+    idx = KvIndexer(BS)
+    h = hashes_for(list(range(16)))
+    idx.apply_event(stored(7, h))
+    idx2 = KvIndexer(BS)
+    for ev in idx.dump_events():
+        idx2.apply_event(ev)
+    assert idx2.find_matches(h).scores == {7: 4}
+
+
+def test_approx_indexer_records_and_expires():
+    approx = ApproxKvIndexer(BS, ttl_s=0.05)
+    toks = list(range(12))
+    approx.record_routing_decision(5, toks)
+    assert approx.find_matches_for_tokens(toks).scores == {5: 3}
+    import time
+    time.sleep(0.08)
+    assert approx.find_matches_for_tokens(toks).scores == {}
+
+
+# ----------------------------- scheduler ------------------------------
+
+
+def test_softmax_temp0_argmin_with_ties():
+    rng = random.Random(0)
+    logits = {1: 5.0, 2: 1.0, 3: 1.0}
+    picks = {softmax_sample(logits, 0.0, rng) for _ in range(50)}
+    assert picks == {2, 3}
+
+
+def test_softmax_temperature_prefers_lower():
+    rng = random.Random(0)
+    logits = {1: 0.0, 2: 10.0}
+    wins = sum(
+        1 for _ in range(200) if softmax_sample(logits, 1.0, rng) == 1
+    )
+    assert wins > 190
+
+
+def test_cost_function_prefers_overlap():
+    """logit = overlap_weight * potential_prefill_blocks + decode_blocks
+    (ref: scheduler.rs:505): the worker holding the prefix wins."""
+    loads = PotentialLoads(BS)
+    sel = select_worker(
+        [1, 2], isl_tokens=32, overlaps={1: 8},  # worker 1 holds all 8 blocks
+        loads=loads, block_size=BS, config=KvRouterConfig(),
+        rng=random.Random(0),
+    )
+    assert sel.worker_id == 1
+    assert sel.overlap_blocks == 8
+    # worker 1: prefill 0 blocks + decode 8 = 8; worker 2: 8 + 8 = 16
+    assert sel.logit == pytest.approx(8.0)
+
+
+def test_cost_function_load_balances_without_overlap():
+    """With no prefix anywhere, accumulated potential load steers new
+    requests to the emptier worker."""
+    loads = PotentialLoads(BS)
+    cfg = KvRouterConfig()
+    rng = random.Random(0)
+    # three requests land on worker 1 (recorded), none finish
+    for i in range(3):
+        loads.add(f"r{i}", 1, isl_tokens=32, overlap_blocks=0)
+    sel = select_worker([1, 2], 32, {}, loads, BS, cfg, rng=rng)
+    assert sel.worker_id == 2
+
+
+def test_potential_loads_lifecycle():
+    loads = PotentialLoads(BS)
+    loads.add("r1", 1, isl_tokens=30, overlap_blocks=2)
+    assert loads.prefill_tokens(1) == 30 - 2 * BS
+    assert loads.decode_blocks(1) == 8  # ceil(30/4)
+    loads.prefill_done("r1")
+    assert loads.prefill_tokens(1) == 0
+    assert loads.decode_blocks(1) == 8
+    loads.free("r1")
+    assert loads.decode_blocks(1) == 0
+    assert loads.num_active == 0
+    loads.free("r1")  # idempotent
+
+
+def test_overlap_weight_override():
+    """High overlap weight makes prefill dominate; weight 0 ignores it."""
+    loads = PotentialLoads(BS)
+    # worker 2 has big decode load but full overlap
+    for i in range(4):
+        loads.add(f"d{i}", 2, isl_tokens=64, overlap_blocks=0)
+        loads.prefill_done(f"d{i}")
+    rng = random.Random(0)
+    sel_hi = select_worker([1, 2], 32, {2: 8}, loads, BS,
+                           KvRouterConfig(), overlap_weight=100.0, rng=rng)
+    assert sel_hi.worker_id == 2
+    sel_zero = select_worker([1, 2], 32, {2: 8}, loads, BS,
+                             KvRouterConfig(), overlap_weight=0.0, rng=rng)
+    assert sel_zero.worker_id == 1
+
+
+def test_busy_threshold_rejection():
+    """All workers above the KV-usage busy threshold → overloaded error
+    (ref: push_router.rs:58-63); a free worker short-circuits it."""
+    from dynamo_tpu.runtime.transport import EngineError
+
+    class FakeClient:
+        class endpoint:
+            path = "t/backend/generate"
+        on_instance_removed = []
+
+        def instance_ids(self):
+            return [1, 2]
+
+    router = KvRouter.__new__(KvRouter)
+    router.client = FakeClient()
+    router.component = None
+    router.block_size = BS
+    router.config = KvRouterConfig(busy_threshold=0.8)
+    router.indexer = KvIndexer(BS)
+    router.approx = None
+    router.loads = PotentialLoads(BS)
+    router.worker_stats = {1: {"kv_usage": 0.95}, 2: {"kv_usage": 0.9}}
+    router._rng = random.Random(0)
+    with pytest.raises(EngineError) as exc:
+        router.find_best_match("r1", list(range(8)))
+    assert exc.value.code == "overloaded"
+    router.worker_stats[2]["kv_usage"] = 0.1
+    sel = router.find_best_match("r2", list(range(8)))
+    assert sel.worker_id == 2
+
+
+# ------------------------------ e2e -----------------------------------
+
+
+def tiny_engine():
+    return InferenceEngine(
+        ModelConfig.tiny(vocab_size=256),
+        EngineConfig(num_blocks=64, block_size=4, max_model_len=128,
+                     max_num_batched_tokens=128,
+                     prefill_buckets=(128,), decode_buckets=(4,),
+                     max_num_seqs=4),
+    )
+
+
+@pytest.fixture
+async def two_worker_cluster():
+    """Store + two engine workers publishing KV events + a router client."""
+    store = StoreServer(host="127.0.0.1", port=0)
+    await store.start()
+    cfg = RuntimeConfig(store_addr=f"127.0.0.1:{store.port}")
+
+    workers = []
+    for _ in range(2):
+        rt = await DistributedRuntime.from_settings(cfg)
+        engine = tiny_engine()
+        await engine.start()
+        ep = rt.namespace("rtest").component("backend").endpoint("generate")
+        served = await ep.serve_endpoint(engine)
+        pub = KvEventPublisher(ep.component, rt.primary_lease)
+        pub.start()
+        engine.kv_event_sink = pub.sink
+        workers.append(
+            {"rt": rt, "engine": engine, "served": served, "pub": pub}
+        )
+
+    front = await DistributedRuntime.from_settings(cfg)
+    ep = front.namespace("rtest").component("backend").endpoint("generate")
+    client = await ep.client()
+    await client.wait_for_instances(2)
+    router = KvRouter(client, ep.component, block_size=4, seed=0)
+    await router.start()
+
+    yield {"workers": workers, "router": router, "client": client}
+
+    await router.stop()
+    await client.stop()
+    for w in workers:
+        await w["pub"].stop()
+        await w["engine"].stop()
+        await w["rt"].shutdown()
+    await front.shutdown()
+    await store.stop()
+
+
+@pytest.mark.anyio
+async def test_router_prefers_prefix_holder(two_worker_cluster):
+    c = two_worker_cluster
+    router: KvRouter = c["router"]
+    warm = c["workers"][0]
+    warm_id = warm["rt"].primary_lease
+    prompt = list(range(1, 33))  # 8 blocks of 4
+
+    # warm worker 0's cache directly (bypassing the router)
+    async for out in warm["engine"].submit(
+        Request(request_id="warm", token_ids=prompt, max_tokens=4)
+    ):
+        pass
+
+    # wait until the router's indexer learned worker 0's blocks
+    for _ in range(100):
+        if router.indexer.num_blocks(warm_id) >= 8:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        pytest.fail("kv events never reached the router indexer")
+
+    sel = router.find_best_match("q1", prompt + [99, 100])
+    assert sel.worker_id == warm_id
+    assert sel.overlap_blocks == 8
+    router.free("q1")
+
+
+@pytest.mark.anyio
+async def test_router_removed_worker_drops_index(two_worker_cluster):
+    c = two_worker_cluster
+    router: KvRouter = c["router"]
+    warm = c["workers"][0]
+    warm_id = warm["rt"].primary_lease
+    prompt = list(range(1, 17))
+    async for _ in warm["engine"].submit(
+        Request(request_id="warm", token_ids=prompt, max_tokens=2)
+    ):
+        pass
+    for _ in range(100):
+        if router.indexer.num_blocks(warm_id) >= 4:
+            break
+        await asyncio.sleep(0.05)
+    # deregister worker 0 → client prune callback → index drop
+    await warm["served"].stop()
+    for _ in range(100):
+        if router.indexer.num_blocks(warm_id) == 0:
+            break
+        await asyncio.sleep(0.05)
+    assert router.indexer.num_blocks(warm_id) == 0
+    other_id = c["workers"][1]["rt"].primary_lease
+    sel = router.find_best_match("q2", prompt)
+    assert sel.worker_id == other_id
+    router.free("q2")
