@@ -73,46 +73,29 @@ def _pow2_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
-class InferenceEngine(AsyncEngine):
-    """Continuous-batching JAX engine exposed as an AsyncEngine.
+class EngineCore(AsyncEngine):
+    """Device-agnostic continuous-batching engine core.
+
+    Owns the scheduler, the asyncio step loop, per-request streaming queues,
+    and KV-event/stat surfacing. Subclasses provide the actual batch
+    execution: :class:`InferenceEngine` dispatches jitted JAX steps; the
+    mocker (``dynamo_tpu.mocker``) simulates step timing without a device
+    (ref: lib/llm/src/mocker/engine.rs:48 — same split, the reference's
+    mocker also reuses the real scheduler semantics).
 
     ``generate`` accepts wire-format dict requests (token_ids + sampling
     options) and yields wire-format dict outputs, so it can be served directly
     by ``Endpoint.serve_endpoint``.
     """
 
-    def __init__(
-        self,
-        model_config: ModelConfig,
-        engine_config: EngineConfig,
-        params: Optional[model_lib.Params] = None,
-        seed: int = 0,
-        devices: Optional[list] = None,
-    ):
-        self.model_config = model_config
+    def __init__(self, engine_config: EngineConfig):
         self.config = engine_config
-        self.mesh = model_lib.make_mesh(engine_config.mesh_shape, devices)
-        if params is None:
-            params = model_lib.init_params(
-                jax.random.PRNGKey(seed), model_config
-            )
-        self.params = model_lib.shard_params(params, self.mesh, model_config)
-        self.cache = model_lib.shard_cache(
-            model_lib.init_cache(model_config, engine_config), self.mesh
-        )
-        self._step_fn = model_lib.make_step_fn(
-            model_config, engine_config, self.mesh
-        )
-        self._rng = jax.random.PRNGKey(seed + 1)
         self.scheduler = Scheduler(engine_config, on_event=self._on_kv_event)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._seqs: Dict[str, SchedSeq] = {}
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._stopped = False
-        self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tpu-step"
-        )
         self._ids = itertools.count(1)
         self.kv_event_sink: Optional[Callable[[dict], None]] = None
         self._pending_events: List[dict] = []
@@ -137,7 +120,10 @@ class InferenceEngine(AsyncEngine):
             if seq.status != SeqStatus.FINISHED:
                 self.scheduler.abort(seq, "shutdown")
                 self._emit_finish(seq, "shutdown")
-        self._executor.shutdown(wait=False)
+        self._shutdown_executor()
+
+    def _shutdown_executor(self) -> None:
+        pass
 
     @property
     def stats(self) -> SchedulerStats:
@@ -231,8 +217,11 @@ class InferenceEngine(AsyncEngine):
 
     # ------------------------- step loop -------------------------------
 
+    async def _execute_batch_async(self, batch) -> Tuple[List[int], List[int]]:
+        """Execute one scheduled batch; returns (prefill, decode) samples."""
+        raise NotImplementedError
+
     async def _run_loop(self) -> None:
-        loop = asyncio.get_running_loop()
         while not self._stopped:
             batch = self.scheduler.schedule()
             if batch.is_empty:
@@ -251,9 +240,7 @@ class InferenceEngine(AsyncEngine):
                 await self._wake.wait()
                 continue
             try:
-                results = await loop.run_in_executor(
-                    self._executor, self._execute_batch, batch
-                )
+                results = await self._execute_batch_async(batch)
             except Exception:
                 log.exception("engine step failed; aborting scheduled seqs")
                 for chunk in batch.prefills:
@@ -321,7 +308,70 @@ class InferenceEngine(AsyncEngine):
                 num_prompt_tokens=seq.prompt_len,
             ))
 
+    # ------------------------- kv events -------------------------------
+
+    def _on_kv_event(self, event: KvEvent) -> None:
+        self._pending_events.append(event.to_dict())
+        if len(self._pending_events) > 10000:
+            del self._pending_events[:5000]
+
+    def _flush_kv_events(self) -> None:
+        if self.kv_event_sink is None:
+            return
+        events, self._pending_events = self._pending_events, []
+        for e in events:
+            try:
+                self.kv_event_sink(e)
+            except Exception:
+                log.exception("kv event sink failed")
+
+    def drain_kv_events(self) -> List[dict]:
+        events, self._pending_events = self._pending_events, []
+        return events
+
+
+class InferenceEngine(EngineCore):
+    """The JAX device engine: jitted unified prefill/decode steps over a
+    paged HBM KV cache, dispatched from a dedicated executor thread so the
+    event loop never blocks on XLA."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        engine_config: EngineConfig,
+        params: Optional[model_lib.Params] = None,
+        seed: int = 0,
+        devices: Optional[list] = None,
+    ):
+        super().__init__(engine_config)
+        self.model_config = model_config
+        self.mesh = model_lib.make_mesh(engine_config.mesh_shape, devices)
+        if params is None:
+            params = model_lib.init_params(
+                jax.random.PRNGKey(seed), model_config
+            )
+        self.params = model_lib.shard_params(params, self.mesh, model_config)
+        self.cache = model_lib.shard_cache(
+            model_lib.init_cache(model_config, engine_config), self.mesh
+        )
+        self._step_fn = model_lib.make_step_fn(
+            model_config, engine_config, self.mesh
+        )
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-step"
+        )
+
+    def _shutdown_executor(self) -> None:
+        self._executor.shutdown(wait=False)
+
     # --------------------- device execution ----------------------------
+
+    async def _execute_batch_async(self, batch) -> Tuple[List[int], List[int]]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self._execute_batch, batch
+        )
 
     def _execute_batch(self, batch) -> Tuple[List[int], List[int]]:
         """Runs on the executor thread: build arrays, dispatch jitted steps."""
@@ -386,24 +436,3 @@ class InferenceEngine(AsyncEngine):
         )
         out = np.asarray(jax.device_get(sampled))
         return [int(out[i]) for i in range(len(seqs))]
-
-    # ------------------------- kv events -------------------------------
-
-    def _on_kv_event(self, event: KvEvent) -> None:
-        self._pending_events.append(event.to_dict())
-        if len(self._pending_events) > 10000:
-            del self._pending_events[:5000]
-
-    def _flush_kv_events(self) -> None:
-        if self.kv_event_sink is None:
-            return
-        events, self._pending_events = self._pending_events, []
-        for e in events:
-            try:
-                self.kv_event_sink(e)
-            except Exception:
-                log.exception("kv event sink failed")
-
-    def drain_kv_events(self) -> List[dict]:
-        events, self._pending_events = self._pending_events, []
-        return events

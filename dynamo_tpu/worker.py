@@ -13,14 +13,13 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import signal
 from typing import Optional
 
 from .engine.config import EngineConfig, ModelConfig
 from .engine.engine import InferenceEngine
-from .llm.discovery import ModelDeploymentCard, register_llm
 from .llm.tokenizer import Tokenizer
 from .runtime.component import DistributedRuntime
+from .serving import ServeOptions, load_tokenizer, run_until_shutdown, serve_engine
 from .utils.config import RuntimeConfig
 from .utils.logging import get_logger
 
@@ -56,16 +55,6 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def load_tokenizer(path: Optional[str]) -> Optional[Tokenizer]:
-    if path is None:
-        return None
-    import os
-
-    if os.path.isdir(path):
-        return Tokenizer.from_pretrained_dir(path)
-    return Tokenizer.from_file(path)
-
-
 async def run_worker(args: argparse.Namespace) -> None:
     config = RuntimeConfig.from_settings()
     if args.store_addr:
@@ -91,73 +80,16 @@ async def run_worker(args: argparse.Namespace) -> None:
     # starve the lease keepalive and get the worker evicted at birth.
     engine = InferenceEngine(model_cfg, eng_cfg)
     runtime = await DistributedRuntime.from_settings(config)
-    await engine.start()
-
-    endpoint = (runtime.namespace().component(args.component)
-                .endpoint(args.endpoint))
-    served = await endpoint.serve_endpoint(
-        engine, advertise_host=args.advertise_host,
-        metadata={"model": name},
+    opts = ServeOptions(
+        name=name, component=args.component, endpoint=args.endpoint,
+        advertise_host=args.advertise_host,
+        migration_limit=args.migration_limit,
     )
-
-    # KV events + load metrics for the KV-aware router / aggregator
-    # (ref: publisher.rs; the in-process seam replaces the ZMQ relay)
-    from .router.publisher import KvEventPublisher, WorkerMetricsPublisher
-
-    kv_pub = KvEventPublisher(endpoint.component, runtime.primary_lease)
-    kv_pub.start()
-    engine.kv_event_sink = kv_pub.sink
-    metrics_pub = WorkerMetricsPublisher(
-        endpoint.component, runtime.primary_lease, lambda: engine.stats
+    served, kv_pub, metrics_pub = await serve_engine(
+        runtime, engine, eng_cfg, opts, tokenizer
     )
-    metrics_pub.start()
-
-    async def clear_kv(request, context):
-        engine.clear_kv_blocks()
-        yield {"cleared": True}
-
-    clear_ep = (runtime.namespace().component(args.component)
-                .endpoint("clear_kv_blocks"))
-    await clear_ep.serve_endpoint(
-        clear_kv, advertise_host=args.advertise_host
-    )
-
-    if tokenizer is not None:
-        card = ModelDeploymentCard(
-            name=name,
-            tokenizer_json=tokenizer.to_json_str(),
-            chat_template=tokenizer.chat_template,
-            context_length=eng_cfg.max_model_len,
-            kv_block_size=eng_cfg.block_size,
-            migration_limit=args.migration_limit,
-            eos_token_ids=list(tokenizer.eos_token_ids),
-            bos_token_id=tokenizer.bos_token_id,
-            runtime_config={
-                "total_kv_blocks": eng_cfg.num_blocks,
-                "max_num_seqs": eng_cfg.max_num_seqs,
-                "max_num_batched_tokens": eng_cfg.max_num_batched_tokens,
-            },
-        )
-        await register_llm(endpoint, card)
-
-    loop = asyncio.get_running_loop()
-
-    def _graceful():
-        log.info("signal received — draining")
-        asyncio.ensure_future(_shutdown())
-
-    async def _shutdown():
-        await served.drain_and_stop()
-        await kv_pub.stop()
-        await metrics_pub.stop()
-        await engine.stop()
-        await runtime.shutdown()
-
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, _graceful)
-
     log.info("worker ready: model=%s engine=%s", name, eng_cfg)
-    await runtime.shutdown_event.wait()
+    await run_until_shutdown(runtime, engine, served, kv_pub, metrics_pub)
 
 
 def main(argv=None) -> None:

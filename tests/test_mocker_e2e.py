@@ -1,0 +1,146 @@
+"""Router/serving e2e over real processes with mocker workers — the
+device-free multi-worker scenarios the reference runs in
+tests/router/test_router_e2e_with_mockers.py: discovery, streaming, KV
+prefix affinity, and worker-death recovery, all through the HTTP surface."""
+
+import json
+import sys
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from test_llm_pipeline import byte_tokenizer  # noqa: E402
+from utils import ManagedProcess, free_port  # noqa: E402
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(scope="module")
+def tokenizer_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    path.write_text(byte_tokenizer().to_json_str())
+    return str(path)
+
+
+@pytest.fixture
+def cluster(tokenizer_file):
+    """store + 2 mocker processes + kv-routed frontend process."""
+    store_port = free_port()
+    http_port = free_port()
+    procs = []
+    store = ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store", "--host", "127.0.0.1",
+         "--port", str(store_port)],
+        name="store", ready_pattern=r"listening",
+    )
+    procs.append(store)
+    store.wait_ready(20)
+    env = {"DYNTPU_STORE_ADDR": f"127.0.0.1:{store_port}"}
+    mockers = []
+    for i in range(2):
+        m = ManagedProcess(
+            ["-m", "dynamo_tpu.mocker", "--model-name", "mock",
+             "--tokenizer", tokenizer_file, "--block-size", "4",
+             "--num-blocks", "256", "--max-model-len", "512",
+             "--speedup-ratio", "20"],
+            name=f"mocker{i}", env=env, ready_pattern=r"mocker ready",
+        )
+        procs.append(m)
+        mockers.append(m)
+    for m in mockers:
+        m.wait_ready(30)
+    frontend = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--host", "127.0.0.1",
+         "--port", str(http_port), "--router-mode", "kv"],
+        name="frontend",
+        env={**env, "DYNTPU_LOG_LEVEL": "DEBUG"},
+        ready_pattern=r"frontend ready",
+    )
+    procs.append(frontend)
+    frontend.wait_ready(30)
+
+    yield {
+        "url": f"http://127.0.0.1:{http_port}",
+        "frontend": frontend,
+        "mockers": mockers,
+        "store": store,
+    }
+
+    for p in reversed(procs):
+        p.terminate()
+
+
+async def _chat(url, content, *, stream=False, max_tokens=8):
+    body = {
+        "model": "mock", "max_tokens": max_tokens, "stream": stream,
+        "messages": [{"role": "user", "content": content}],
+    }
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{url}/v1/chat/completions", json=body,
+            timeout=aiohttp.ClientTimeout(total=60),
+        ) as r:
+            if stream:
+                chunks = []
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                return r.status, chunks
+            return r.status, await r.json()
+
+
+async def test_completion_and_streaming(cluster):
+    status, body = await _chat(cluster["url"], "hello mocker")
+    assert status == 200, body
+    assert body["usage"]["completion_tokens"] == 8
+    # streamed variant arrives as incremental chunks
+    status, chunks = await _chat(cluster["url"], "hello mocker stream",
+                                 stream=True)
+    assert status == 200
+    content_chunks = [
+        c for c in chunks
+        if c["choices"][0]["delta"].get("content")
+    ]
+    # random byte-level tokens can buffer in the UTF-8 incremental decoder,
+    # so chunks ≤ tokens; incremental arrival plus exact final usage is the
+    # invariant
+    assert len(content_chunks) >= 2
+    final = [c for c in chunks if c["choices"][0]["finish_reason"]]
+    assert final and final[-1]["usage"]["completion_tokens"] == 8
+
+
+async def test_kv_affinity_across_processes(cluster):
+    """Second request with the same long prompt must route to the worker
+    that cached it (overlap > 0 in the router's debug log — the reference
+    asserts the same via 'Selected worker: …, logit:' log scraping)."""
+    prompt = "the quick brown fox jumps over the lazy dog " * 8
+    await _chat(cluster["url"], prompt)
+    # allow kv events to propagate, then repeat
+    import asyncio
+    await asyncio.sleep(1.0)
+    await _chat(cluster["url"], prompt)
+    m = cluster["frontend"].wait_log(
+        r"selected worker (\d+) .*overlap=([1-9]\d*) blocks", 10
+    )
+    assert int(m.group(2)) > 0
+
+
+async def test_worker_death_recovery(cluster):
+    """SIGKILL one mocker: the client prunes it on lease expiry and traffic
+    flows to the survivor (ref: fault tolerance suite semantics)."""
+    status, _ = await _chat(cluster["url"], "warmup before kill")
+    assert status == 200
+    cluster["mockers"][0].kill()
+    # lease TTL default is a few seconds; keep retrying until pruned
+    import asyncio
+    deadline = asyncio.get_event_loop().time() + 30
+    last = None
+    while asyncio.get_event_loop().time() < deadline:
+        status, last = await _chat(cluster["url"], "after kill")
+        if status == 200:
+            break
+        await asyncio.sleep(1.0)
+    assert status == 200, last

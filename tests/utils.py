@@ -1,0 +1,106 @@
+"""Multi-process test harness (role of the reference's ManagedProcess,
+ref: tests/utils/managed_process.py): spawn a component process, gate on a
+log pattern, scrape its log, and guarantee cleanup by PID."""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Optional
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ManagedProcess:
+    """A spawned component process with log-pattern readiness gating."""
+
+    def __init__(
+        self, args: list, *, name: str, env: Optional[dict] = None,
+        ready_pattern: str = r"ready",
+    ):
+        self.name = name
+        self.args = args
+        self.ready_pattern = ready_pattern
+        self.log_path = Path(tempfile.mkstemp(
+            prefix=f"dyntpu-{name}-", suffix=".log"
+        )[1])
+        full_env = dict(os.environ)
+        full_env["PYTHONPATH"] = REPO
+        full_env.setdefault("JAX_PLATFORMS", "cpu")
+        full_env.update(env or {})
+        self._log_file = open(self.log_path, "w")
+        self.proc = subprocess.Popen(
+            [sys.executable, *args], stdout=self._log_file,
+            stderr=subprocess.STDOUT, env=full_env, cwd=REPO,
+        )
+
+    # -- readiness / scraping --
+
+    def log(self) -> str:
+        try:
+            return self.log_path.read_text()
+        except FileNotFoundError:
+            return ""
+
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        pat = re.compile(self.ready_pattern)
+        while time.monotonic() < deadline:
+            if pat.search(self.log()):
+                return
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.name} exited rc={self.proc.returncode}:\n{self.log()}"
+                )
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"{self.name} not ready ({self.ready_pattern!r}):\n{self.log()}"
+        )
+
+    def wait_log(self, pattern: str, timeout_s: float = 30.0) -> "re.Match":
+        deadline = time.monotonic() + timeout_s
+        pat = re.compile(pattern)
+        while time.monotonic() < deadline:
+            m = pat.search(self.log())
+            if m:
+                return m
+            time.sleep(0.1)
+        raise TimeoutError(f"{self.name}: {pattern!r} not seen:\n{self.log()}")
+
+    # -- teardown --
+
+    def terminate(self, timeout_s: float = 10.0) -> int:
+        """SIGTERM → graceful drain; SIGKILL on timeout."""
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5)
+        self._log_file.close()
+        return self.proc.returncode
+
+    def kill(self) -> None:
+        """Hard kill (fault-injection path)."""
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(5)
+        self._log_file.close()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
