@@ -1,0 +1,22 @@
+"""Disaggregated prefill/decode serving
+(ref: docs/architecture/disagg_serving.md; components/backends/vllm/src/
+dynamo/vllm/handlers.py:89,207).
+
+The decode worker orchestrates: it pre-allocates KV blocks, pushes a
+bounded-prefill request to a prefill worker, receives the KV blocks over the
+transfer plane into those pre-allocated slots, and resumes decoding from the
+remotely-sampled first token. TPU-native data plane: jitted block
+gather/scatter (``engine.model.make_kv_ops``) host-relayed over the TCP
+transport; same-mesh transfers ride ICI through the identical jitted ops.
+"""
+
+from .handlers import DecodeHandler, DisaggConfig, PrefillHandler
+from .protocol import kv_from_wire, kv_to_wire
+
+__all__ = [
+    "DecodeHandler",
+    "DisaggConfig",
+    "PrefillHandler",
+    "kv_from_wire",
+    "kv_to_wire",
+]

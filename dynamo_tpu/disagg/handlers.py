@@ -1,0 +1,236 @@
+"""Decode/prefill worker handlers for disaggregated serving
+(ref: components/backends/vllm/src/dynamo/vllm/handlers.py:89 Decode, :207
+Prefill; conditional thresholds ref: lib/llm/src/disagg_router.rs:230).
+
+Flow (decode-orchestrated, matching the reference):
+
+  DecodeHandler.generate(request)
+    ├─ below threshold / no prefill workers / pool full → local engine path
+    ├─ reserve blocks on the decode engine
+    ├─ push prefill request to a prefill worker (round-robin), carrying
+    │  kv_transfer params {addr, request_id} — our kv_inject ingress addr
+    ├─ PrefillHandler: engine.prefill_held → extract_kv → push blocks to
+    │  decode's kv_inject endpoint → respond {token_id}
+    ├─ inject arrives concurrently; decode awaits its completion event
+    └─ engine.resume_prefilled(seq, first_token) → decode stream
+
+The prefill worker *pushes* KV into pre-allocated decode blocks (the NIXL
+write direction); bulk bytes ride the TCP transport's binary frames while
+control messages carry only block metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..engine.engine import EngineCore, InferenceEngine, Request
+from ..runtime.component import Client
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..utils.logging import get_logger
+from .protocol import kv_from_wire, kv_to_wire
+
+log = get_logger("disagg")
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disagg thresholds (ref: disagg_router.rs:230 — remote
+    prefill only when the *new* work is long enough to be worth the
+    transfer)."""
+
+    min_remote_prefill_tokens: int = 32
+    # refuse remote prefill when the decode pool is above this usage
+    max_reserve_usage: float = 0.95
+
+
+class PrefillHandler(AsyncEngine):
+    """Prefill worker: bounded prefill + KV push-back
+    (ref: handlers.py:207 PrefillWorkerHandler)."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        xfer: Dict[str, Any] = request.get("kv_transfer") or {}
+        req = Request(
+            request_id=context.id,
+            token_ids=list(request["token_ids"]),
+            max_tokens=1,
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+        )
+        seq, first_token = await self.engine.prefill_held(req)
+        try:
+            data = await self.engine.extract_kv(seq)
+        finally:
+            self.engine.release_held(seq)
+        payload = kv_to_wire(data)
+        payload["request_id"] = xfer["request_id"]
+        # push the blocks into the decode worker's pre-allocated slots
+        transport = self.engine_runtime_transport(context)
+        async for ack in transport.generate(xfer["addr"], payload, Context()):
+            if not ack.get("ok", False):
+                raise RuntimeError(f"kv inject rejected: {ack}")
+        yield {"token_ids": [first_token], "finished": True,
+               "finish_reason": "remote_prefill"}
+
+    # seam for tests / runtime injection
+    def engine_runtime_transport(self, context: Context):
+        from ..runtime.transport import TransportClient
+
+        if not hasattr(self, "_transport"):
+            self._transport = TransportClient()
+        return self._transport
+
+
+class KvInjectHandler(AsyncEngine):
+    """Decode-worker ingress for pushed KV blocks: scatters the payload
+    into the reserved sequence's blocks and signals the waiting decode
+    handler."""
+
+    def __init__(self, decode: "DecodeHandler"):
+        self.decode = decode
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        rid = request["request_id"]
+        pending = self.decode.pending.get(rid)
+        if pending is None:
+            yield {"ok": False, "error": f"unknown request {rid}"}
+            return
+        seq, done = pending
+        try:
+            await self.decode.engine.inject_kv(seq, kv_from_wire(request))
+        except Exception as exc:
+            done.set_exception(exc)
+            yield {"ok": False, "error": str(exc)}
+            return
+        done.set_result(True)
+        yield {"ok": True}
+
+
+class DecodeHandler(AsyncEngine):
+    """Decode worker: conditional remote prefill + resume
+    (ref: handlers.py:89 DecodeWorkerHandler)."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        prefill_client: Optional[Client] = None,
+        config: Optional[DisaggConfig] = None,
+    ):
+        self.engine = engine
+        self.prefill_client = prefill_client
+        self.config = config or DisaggConfig()
+        # request_id -> (reserved seq, inject-complete future)
+        self.pending: Dict[str, tuple] = {}
+        self.kv_inject_addr: Optional[str] = None  # set after serving
+        self.num_remote_prefills = 0
+        self.num_local_prefills = 0
+
+    def inject_handler(self) -> KvInjectHandler:
+        return KvInjectHandler(self)
+
+    def _should_remote_prefill(self, token_ids: list) -> bool:
+        if self.prefill_client is None or self.kv_inject_addr is None:
+            return False
+        if not self.prefill_client.instance_ids():
+            return False
+        if len(token_ids) < self.config.min_remote_prefill_tokens:
+            return False
+        if self.engine.stats.kv_usage > self.config.max_reserve_usage:
+            return False
+        return True
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        token_ids = list(request["token_ids"])
+        if not self._should_remote_prefill(token_ids):
+            self.num_local_prefills += 1
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        req = Request(
+            request_id=context.id,
+            token_ids=token_ids,
+            max_tokens=int(request.get("max_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            eos_token_ids=tuple(request.get("eos_token_ids", ())),
+            ignore_eos=bool(request.get("ignore_eos", False)),
+        )
+        seq = self.engine.reserve_sequence(req)
+        if seq is None:  # pool can't host it — prefill locally instead
+            self.num_local_prefills += 1
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        done: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.pending[context.id] = (seq, done)
+        try:
+            prefill_request = {
+                "token_ids": token_ids,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "kv_transfer": {
+                    "request_id": context.id,
+                    "addr": self.kv_inject_addr,
+                },
+            }
+            first_token: Optional[int] = None
+            async for item in self.prefill_client.round_robin(
+                prefill_request, context
+            ):
+                first_token = item["token_ids"][0]
+            if first_token is None:
+                raise RuntimeError("prefill worker returned no token")
+            await asyncio.wait_for(done, timeout=120.0)
+            self.num_remote_prefills += 1
+            log.debug("remote prefill complete: %s (%d tokens)",
+                      context.id, len(token_ids))
+        except Exception:
+            # remote prefill failed — fall back to local so the request
+            # still completes (the Migration operator retries above us for
+            # stream-level failures)
+            log.exception("remote prefill failed — falling back to local")
+            self.engine.cancel_reservation(seq)
+            self.pending.pop(context.id, None)
+            self.num_local_prefills += 1
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+        finally:
+            self.pending.pop(context.id, None)
+
+        async def _on_stop() -> None:
+            await context.wait_stopped()
+            self.engine.abort(req.request_id,
+                              "killed" if context.is_killed() else "cancelled")
+
+        watcher = asyncio.create_task(_on_stop())
+        try:
+            async for out in self.engine.resume_prefilled(seq, first_token):
+                if context.is_killed():
+                    return
+                yield {
+                    "token_ids": [out.token_id],
+                    "index": out.index,
+                    "finished": out.finished,
+                    "finish_reason": out.finish_reason,
+                    "num_prompt_tokens": out.num_prompt_tokens,
+                }
+                if out.finished:
+                    return
+            # engine path exhausted without a finished marker (abort):
+            # nothing further to yield
+        finally:
+            watcher.cancel()

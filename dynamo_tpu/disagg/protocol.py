@@ -1,0 +1,42 @@
+"""Wire encoding for KV block payloads and transfer params
+(ref: the ``kv_transfer_params`` dict threaded through handlers.py:147-188
+and the block-ID-only descriptor design of disagg_serving.md §Efficient KV
+Transfer — metadata rides the control message; bulk bytes ride the
+transport's binary frames)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+try:  # bfloat16 numpy interop (jax dependency, always present with jax)
+    import ml_dtypes
+
+    _DTYPES = {"bfloat16": np.dtype(ml_dtypes.bfloat16)}
+except Exception:  # pragma: no cover
+    _DTYPES = {}
+
+
+def _np_dtype(name: str) -> np.dtype:
+    return _DTYPES.get(name, np.dtype(name))
+
+
+def kv_to_wire(data: Dict[str, np.ndarray]) -> dict:
+    """{"k","v"} arrays -> msgpack-safe dict (raw bytes + shape + dtype)."""
+    k, v = data["k"], data["v"]
+    return {
+        "shape": list(k.shape),
+        "dtype": k.dtype.name,
+        "k": k.tobytes(),
+        "v": v.tobytes(),
+    }
+
+
+def kv_from_wire(wire: dict) -> Dict[str, np.ndarray]:
+    shape = tuple(wire["shape"])
+    dt = _np_dtype(wire["dtype"])
+    return {
+        "k": np.frombuffer(wire["k"], dtype=dt).reshape(shape),
+        "v": np.frombuffer(wire["v"], dtype=dt).reshape(shape),
+    }

@@ -174,6 +174,89 @@ class EngineCore(AsyncEngine):
             self.scheduler.abort(seq, reason)
             self._emit_finish(seq, reason)
 
+    # --------------- disaggregated prefill/decode hooks ----------------
+    # (ref: the decode/prefill handler split in components/backends/vllm/
+    #  src/dynamo/vllm/handlers.py:89,207 — here the engine itself exposes
+    #  the hold/reserve/resume seams the reference gets from vLLM's
+    #  kv_transfer connector)
+
+    async def prefill_held(self, request: Request):
+        """Prefill-worker side: run the prompt to its first token, keeping
+        the KV blocks alive for extraction. Returns (seq, first_token);
+        caller must ``release_held(seq)`` after extracting."""
+        await self.start()
+        if not request.token_ids:
+            raise ValueError("empty prompt")
+        seq = SchedSeq(
+            seq_id=request.request_id or f"seq-{next(self._ids)}",
+            prompt_ids=list(request.token_ids),
+            max_tokens=1,
+            eos_token_ids=frozenset(),
+            temperature=request.temperature,
+            top_k=request.top_k,
+            hold_blocks=True,
+        )
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[seq.seq_id] = queue
+        self._seqs[seq.seq_id] = seq
+        self.scheduler.add(seq)
+        self._wake.set()
+        out = await queue.get()
+        if out.finish_reason not in ("length", "stop"):
+            self.release_held(seq)
+            raise RuntimeError(
+                f"remote prefill failed: {out.finish_reason}"
+            )
+        return seq, out.token_id
+
+    def release_held(self, seq: SchedSeq) -> None:
+        self.scheduler.release_held(seq)
+        self._queues.pop(seq.seq_id, None)
+        self._seqs.pop(seq.seq_id, None)
+
+    def reserve_sequence(self, request: Request) -> Optional[SchedSeq]:
+        """Decode-worker side: pre-allocate prompt blocks for KV injection.
+        Returns None when the pool can't host the prompt right now (caller
+        falls back to local prefill)."""
+        seq = SchedSeq(
+            seq_id=request.request_id or f"seq-{next(self._ids)}",
+            prompt_ids=list(request.token_ids),
+            max_tokens=max(1, request.max_tokens),
+            eos_token_ids=(frozenset() if request.ignore_eos
+                           else frozenset(request.eos_token_ids)),
+            temperature=request.temperature,
+            top_k=request.top_k,
+        )
+        if not self.scheduler.reserve(seq):
+            return None
+        self._queues[seq.seq_id] = asyncio.Queue()
+        self._seqs[seq.seq_id] = seq
+        return seq
+
+    def cancel_reservation(self, seq: SchedSeq) -> None:
+        self.scheduler.release_held(seq)  # reserved blocks, same release
+        self._queues.pop(seq.seq_id, None)
+        self._seqs.pop(seq.seq_id, None)
+
+    async def resume_prefilled(
+        self, seq: SchedSeq, first_token: int
+    ) -> AsyncIterator[StepOutput]:
+        """Decode-worker side: activate a reserved sequence whose KV was
+        injected; streams from the remotely-sampled first token onward."""
+        await self.start()
+        self.scheduler.admit_prefilled(seq, first_token)
+        self._emit_token(seq)
+        self._wake.set()
+        queue = self._queues[seq.seq_id]
+        try:
+            while True:
+                out = await queue.get()
+                yield out
+                if out.finished:
+                    return
+        finally:
+            self._drop(seq)
+
     def _drop(self, seq: SchedSeq) -> None:
         if seq.status != SeqStatus.FINISHED:
             self.scheduler.abort(seq, "cancelled")
@@ -361,9 +444,41 @@ class InferenceEngine(EngineCore):
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
         )
+        self._kv_extract, self._kv_inject = model_lib.make_kv_ops(
+            engine_config
+        )
 
     def _shutdown_executor(self) -> None:
         self._executor.shutdown(wait=False)
+
+    # ------------------ KV block transfer (disagg) ---------------------
+    # Both run on the single step executor thread, serialising them with
+    # step execution — the cache buffer is donated every step, so nothing
+    # may touch it concurrently.
+
+    async def extract_kv(self, seq) -> Dict[str, np.ndarray]:
+        """Gather a held sequence's KV blocks to host memory."""
+        loop = asyncio.get_running_loop()
+        block_ids = np.asarray(seq.block_table, np.int32)
+
+        def _ex():
+            data = self._kv_extract(self.cache, block_ids)
+            return {
+                "k": np.asarray(jax.device_get(data["k"])),
+                "v": np.asarray(jax.device_get(data["v"])),
+            }
+
+        return await loop.run_in_executor(self._executor, _ex)
+
+    async def inject_kv(self, seq, data: Dict[str, np.ndarray]) -> None:
+        """Scatter received KV into a reserved sequence's blocks."""
+        loop = asyncio.get_running_loop()
+        block_ids = np.asarray(seq.block_table, np.int32)
+
+        def _in():
+            self.cache = self._kv_inject(self.cache, block_ids, data)
+
+        await loop.run_in_executor(self._executor, _in)
 
     # --------------------- device execution ----------------------------
 

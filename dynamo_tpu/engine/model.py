@@ -331,3 +331,45 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     params+cache carry their shardings from device_put; data args are small
     host arrays XLA replicates, so no explicit in_shardings are needed."""
     return jax.jit(raw_step_fn(cfg, eng), donate_argnums=(1,))
+
+
+# ------------------------ KV block transfer ops ---------------------------
+#
+# The disaggregated P→D data plane (role of the reference's NIXL transfer +
+# block_copy.cu resharding kernels, ref: lib/llm/src/block_manager/
+# distributed/transfer.rs, kernels/block_copy.cu:41): gather a sequence's
+# physical blocks out of the paged cache / scatter received blocks into
+# pre-allocated slots. XLA compiles these to fused gather/scatter; on TPU
+# the same jitted fns ride ICI when source and destination share a mesh.
+
+
+def make_kv_ops(eng: EngineConfig):
+    """(extract, inject) jitted block gather/scatter over the paged cache.
+
+    extract(cache, block_ids[N]) -> {"k","v"}: [L, N*bs, KV, hd]
+    inject(cache, block_ids[N], data) -> cache  (donated, in-place scatter)
+    """
+    bs = eng.block_size
+
+    def _slots(block_ids: jax.Array) -> jax.Array:
+        return (block_ids[:, None] * bs
+                + jnp.arange(bs)[None, :]).reshape(-1)
+
+    def extract(cache: Cache, block_ids: jax.Array) -> Cache:
+        slots = _slots(block_ids)
+        return {
+            "k": jnp.take(cache["k"], slots, axis=1),
+            "v": jnp.take(cache["v"], slots, axis=1),
+        }
+
+    def inject(cache: Cache, block_ids: jax.Array, data: Cache) -> Cache:
+        slots = _slots(block_ids)
+        return {
+            "k": cache["k"].at[:, slots].set(data["k"]),
+            "v": cache["v"].at[:, slots].set(data["v"]),
+        }
+
+    return (
+        jax.jit(extract),
+        jax.jit(inject, donate_argnums=(0,)),
+    )

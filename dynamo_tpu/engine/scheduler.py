@@ -175,6 +175,9 @@ class SchedSeq:
     finish_reason: Optional[str] = None
     token_seq: Optional[TokenBlockSequence] = None
     preemptions: int = 0
+    # disagg: keep blocks alive after finish until the KV is extracted
+    # (prefill worker side; released via Scheduler.release_held)
+    hold_blocks: bool = False
 
     @property
     def total_tokens(self) -> int:
@@ -436,11 +439,52 @@ class Scheduler:
     def _finish(self, seq: SchedSeq, reason: str) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
-        self._release_blocks(seq)
+        if not seq.hold_blocks:
+            self._release_blocks(seq)
         if seq in self.running:
             self.running.remove(seq)
         if seq in self.waiting:
             self.waiting.remove(seq)
+        self._refresh_stats()
+
+    def release_held(self, seq: SchedSeq) -> None:
+        """Free a finished hold_blocks sequence after KV extraction."""
+        self._release_blocks(seq)
+        self._refresh_stats()
+
+    # -- disagg decode-side admission (remote prefill) --
+
+    def reserve(self, seq: SchedSeq) -> bool:
+        """Pre-allocate blocks covering the prompt for KV injection
+        (the decode side of disagg: the reference decode worker's engine
+        pre-allocates blocks NIXL writes into, ref: disagg_serving.md
+        §Efficient KV Transfer). Returns False (no side effects) when the
+        pool can't cover it above the watermark."""
+        seq.token_seq = TokenBlockSequence.from_tokens(
+            seq.prompt_ids, self.config.block_size
+        )
+        bs = self.config.block_size
+        need = (seq.prompt_len + bs - 1) // bs
+        if not self._can_allocate(need):
+            return False
+        for _ in range(need):
+            bid = self.pool.allocate()
+            if bid is None:  # watermark said yes but pool is fragmented-dry
+                self._release_blocks(seq)
+                return False
+            seq.block_table.append(bid)
+        return True
+
+    def admit_prefilled(self, seq: SchedSeq, first_token: int) -> None:
+        """Activate a reserved sequence whose prompt KV was injected and
+        whose first token was sampled remotely: seal prefix blocks (emitting
+        stored events — this worker now owns those blocks) and enter the
+        decode loop."""
+        seq.num_computed = seq.prompt_len
+        self._seal_complete_blocks(seq)
+        self._append_token(seq, first_token)
+        seq.status = SeqStatus.RUNNING
+        self.running.append(seq)
         self._refresh_stats()
 
     def _can_allocate(self, need: int) -> bool:

@@ -36,16 +36,18 @@ async def serve_engine(
     eng_cfg: EngineConfig,
     opts: ServeOptions,
     tokenizer: Optional[Tokenizer] = None,
+    handler=None,
 ):
-    """Serve ``engine`` on the cluster; returns the served endpoint and the
-    publishers (caller owns shutdown ordering)."""
+    """Serve ``engine`` (or a wrapping ``handler``) on the cluster; returns
+    the served endpoint and the publishers (caller owns shutdown ordering)."""
     from .router.publisher import KvEventPublisher, WorkerMetricsPublisher
 
     await engine.start()
     endpoint = (runtime.namespace().component(opts.component)
                 .endpoint(opts.endpoint))
     served = await endpoint.serve_endpoint(
-        engine, advertise_host=opts.advertise_host,
+        handler if handler is not None else engine,
+        advertise_host=opts.advertise_host,
         metadata={"model": opts.name},
     )
 

@@ -52,6 +52,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--mesh", default="1,1", help="dp,tp mesh axis sizes")
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--advertise-host", default="127.0.0.1")
+    p.add_argument(
+        "--disagg-mode", default="agg", choices=["agg", "decode", "prefill"],
+        help="aggregated, decode-orchestrator, or prefill worker "
+             "(ref: disagg_serving.md)",
+    )
+    p.add_argument("--prefill-component", default="prefill")
+    p.add_argument("--min-remote-prefill-tokens", type=int, default=32)
     return p.parse_args(argv)
 
 
@@ -80,15 +87,49 @@ async def run_worker(args: argparse.Namespace) -> None:
     # starve the lease keepalive and get the worker evicted at birth.
     engine = InferenceEngine(model_cfg, eng_cfg)
     runtime = await DistributedRuntime.from_settings(config)
+
+    handler = None
+    component = args.component
+    if args.disagg_mode == "prefill":
+        from .disagg import PrefillHandler
+
+        # prefill workers serve on their own component; decode workers own
+        # model registration (ref: vllm main.py:137 init_prefill)
+        component = args.prefill_component
+        handler = PrefillHandler(engine)
+        tokenizer = None
+    elif args.disagg_mode == "decode":
+        from .disagg import DecodeHandler, DisaggConfig
+
+        prefill_client = await (
+            runtime.namespace().component(args.prefill_component)
+            .endpoint("generate").client()
+        )
+        handler = DecodeHandler(
+            engine, prefill_client,
+            DisaggConfig(
+                min_remote_prefill_tokens=args.min_remote_prefill_tokens
+            ),
+        )
+
     opts = ServeOptions(
-        name=name, component=args.component, endpoint=args.endpoint,
+        name=name, component=component, endpoint=args.endpoint,
         advertise_host=args.advertise_host,
         migration_limit=args.migration_limit,
     )
     served, kv_pub, metrics_pub = await serve_engine(
-        runtime, engine, eng_cfg, opts, tokenizer
+        runtime, engine, eng_cfg, opts, tokenizer, handler=handler
     )
-    log.info("worker ready: model=%s engine=%s", name, eng_cfg)
+    if args.disagg_mode == "decode":
+        inject_ep = (runtime.namespace().component(component)
+                     .endpoint("kv_inject"))
+        inject_served = await inject_ep.serve_endpoint(
+            handler.inject_handler(), advertise_host=args.advertise_host
+        )
+        handler.kv_inject_addr = inject_served.instance.addr
+
+    log.info("worker ready: model=%s mode=%s engine=%s",
+             name, args.disagg_mode, eng_cfg)
     await run_until_shutdown(runtime, engine, served, kv_pub, metrics_pub)
 
 
