@@ -99,6 +99,7 @@ class EngineCore(AsyncEngine):
         self._ids = itertools.count(1)
         self.kv_event_sink: Optional[Callable[[dict], None]] = None
         self._pending_events: List[dict] = []
+        self.kvbm = None  # multi-tier block manager (attach_kvbm)
         # counters
         self.num_generated_tokens = 0
         self.num_steps = 0
@@ -154,6 +155,17 @@ class EngineCore(AsyncEngine):
             temperature=request.temperature,
             top_k=request.top_k,
         )
+        if self.kvbm is not None:
+            # promote host-tier prefix blocks into G1 before admission so
+            # the scheduler's prefix match serves them as native hits
+            from ..tokens import TokenBlockSequence
+
+            try:
+                await self.kvbm.onboard_prefix(TokenBlockSequence.from_tokens(
+                    seq.prompt_ids, self.config.block_size
+                ))
+            except Exception:
+                log.exception("kvbm onboard failed — prefilling from scratch")
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[seq.seq_id] = queue
         self._seqs[seq.seq_id] = seq
@@ -317,6 +329,12 @@ class EngineCore(AsyncEngine):
                     self.scheduler.abort(seq, "error")
                     self._emit_finish(seq, "error")
                     continue
+                if self.kvbm is not None:
+                    try:  # going idle: drain the offload backlog
+                        while await self.kvbm.tick():
+                            pass
+                    except Exception:
+                        log.exception("kvbm idle drain failed")
                 self._wake.clear()
                 if self._stopped:
                     return
@@ -340,6 +358,11 @@ class EngineCore(AsyncEngine):
                 # request would hang forever
                 log.exception("postprocess failed")
             self._flush_kv_events()
+            if self.kvbm is not None:
+                try:
+                    await self.kvbm.tick()
+                except Exception:
+                    log.exception("kvbm offload tick failed")
 
     def _postprocess(self, batch, results) -> None:
         prefill_samples, decode_samples = results
@@ -397,6 +420,8 @@ class EngineCore(AsyncEngine):
         self._pending_events.append(event.to_dict())
         if len(self._pending_events) > 10000:
             del self._pending_events[:5000]
+        if self.kvbm is not None:
+            self.kvbm.on_pool_event(event)
 
     def _flush_kv_events(self) -> None:
         if self.kv_event_sink is None:
@@ -456,29 +481,71 @@ class InferenceEngine(EngineCore):
     # step execution — the cache buffer is donated every step, so nothing
     # may touch it concurrently.
 
-    async def extract_kv(self, seq) -> Dict[str, np.ndarray]:
-        """Gather a held sequence's KV blocks to host memory."""
+    @staticmethod
+    def _pad_pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    async def extract_kv_blocks(self, block_ids) -> Dict[str, np.ndarray]:
+        """Gather arbitrary physical blocks to host memory. The id list is
+        padded to a power of two (pads gather the trash block) so XLA
+        compiles O(log N) program variants, and the pad is sliced off."""
         loop = asyncio.get_running_loop()
-        block_ids = np.asarray(seq.block_table, np.int32)
+        n = len(block_ids)
+        padded = np.zeros((self._pad_pow2(n),), np.int32)
+        padded[:n] = block_ids
+        bs = self.config.block_size
 
         def _ex():
-            data = self._kv_extract(self.cache, block_ids)
+            data = self._kv_extract(self.cache, padded)
             return {
-                "k": np.asarray(jax.device_get(data["k"])),
-                "v": np.asarray(jax.device_get(data["v"])),
+                "k": np.asarray(jax.device_get(data["k"]))[:, : n * bs],
+                "v": np.asarray(jax.device_get(data["v"]))[:, : n * bs],
             }
 
         return await loop.run_in_executor(self._executor, _ex)
 
-    async def inject_kv(self, seq, data: Dict[str, np.ndarray]) -> None:
-        """Scatter received KV into a reserved sequence's blocks."""
+    async def inject_kv_blocks(
+        self, block_ids, data: Dict[str, np.ndarray]
+    ) -> None:
+        """Scatter per-block KV into physical blocks (pads scatter into the
+        trash block, which absorbs garbage by design)."""
         loop = asyncio.get_running_loop()
-        block_ids = np.asarray(seq.block_table, np.int32)
+        n = len(block_ids)
+        m = self._pad_pow2(n)
+        padded = np.zeros((m,), np.int32)
+        padded[:n] = block_ids
+        if m != n:
+            bs = self.config.block_size
+            pad_shape = list(data["k"].shape)
+            pad_shape[1] = (m - n) * bs
+            pad = np.zeros(pad_shape, data["k"].dtype)
+            data = {
+                "k": np.concatenate([data["k"], pad], axis=1),
+                "v": np.concatenate([data["v"], pad], axis=1),
+            }
 
         def _in():
-            self.cache = self._kv_inject(self.cache, block_ids, data)
+            self.cache = self._kv_inject(self.cache, padded, data)
 
         await loop.run_in_executor(self._executor, _in)
+
+    async def extract_kv(self, seq) -> Dict[str, np.ndarray]:
+        """Gather a held sequence's KV blocks to host memory."""
+        return await self.extract_kv_blocks(seq.block_table)
+
+    async def inject_kv(self, seq, data: Dict[str, np.ndarray]) -> None:
+        """Scatter received KV into a reserved sequence's blocks."""
+        await self.inject_kv_blocks(seq.block_table, data)
+
+    def attach_kvbm(self, config=None):
+        """Enable the multi-tier block manager on this engine."""
+        from ..kvbm.manager import KvbmConfig, KvbmManager
+
+        self.kvbm = KvbmManager(self, config or KvbmConfig())
+        return self.kvbm
 
     # --------------------- device execution ----------------------------
 

@@ -105,6 +105,26 @@ class BlockPool:
             self._ref[bid] += 1
         return bid
 
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._cached
+
+    def adopt(self, seq_hash: int, block_hash: int,
+              parent: Optional[int]) -> Optional[int]:
+        """Allocate a block and register it as sealed WITHOUT any sequence
+        owning it — the KVBM onboard path (G2/G3 → G1). Returned with
+        refcount 1 so it cannot be evicted while the caller injects the KV;
+        ``release_adopted`` afterwards makes it an evictable cache hit."""
+        if seq_hash in self._cached:
+            return None
+        bid = self.allocate()
+        if bid is None:
+            return None
+        self.seal(bid, seq_hash, block_hash, parent)
+        return bid
+
+    def release_adopted(self, bid: int) -> None:
+        self.decref(bid)  # refcount 0 + sealed → evictable (cached)
+
     def incref(self, bid: int) -> None:
         self._ref[bid] += 1
 
@@ -128,7 +148,8 @@ class BlockPool:
         self._parent_of[bid] = parent
         self._cached[seq_hash] = bid
         self._emit(KvEvent("stored", [
-            {"seq_hash": seq_hash, "block_hash": block_hash, "parent": parent}
+            {"seq_hash": seq_hash, "block_hash": block_hash,
+             "parent": parent, "block_id": bid}
         ]))
 
     def clear(self) -> None:

@@ -1,0 +1,127 @@
+"""G2 host-memory + G3 disk block pools, sequence-hash keyed
+(ref: lib/llm/src/block_manager/pool/managed.rs — active/inactive pools
+with hash reuse; storage/disk.rs for the disk tier).
+
+A block's payload is its per-block KV: ``{"k","v"}: [L, bs, KV, hd]``
+numpy arrays. G2 is an LRU dict bounded by ``capacity_blocks``; overflow
+spills to G3 (one file per block under ``disk_dir``) when configured,
+else drops. Lookups check G2 then G3 (disk hits are re-promoted to G2).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger("kvbm.host_pool")
+
+
+@dataclass
+class HostPoolStats:
+    g2_blocks: int = 0
+    g3_blocks: int = 0
+    g2_hits: int = 0
+    g3_hits: int = 0
+    misses: int = 0
+    spills: int = 0
+    drops: int = 0
+
+
+class HostBlockPool:
+    def __init__(
+        self,
+        capacity_blocks: int,
+        disk_dir: Optional[str] = None,
+        disk_capacity_blocks: int = 0,
+    ):
+        self.capacity = capacity_blocks
+        self.disk_dir = Path(disk_dir) if disk_dir else None
+        self.disk_capacity = disk_capacity_blocks if disk_dir else 0
+        if self.disk_dir is not None:
+            self.disk_dir.mkdir(parents=True, exist_ok=True)
+        self._mem: "OrderedDict[int, Dict[str, np.ndarray]]" = OrderedDict()
+        self._disk: "OrderedDict[int, Path]" = OrderedDict()
+        self.stats = HostPoolStats()
+
+    # -- query --
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._mem or seq_hash in self._disk
+
+    def get(self, seq_hash: int) -> Optional[Dict[str, np.ndarray]]:
+        data = self._mem.get(seq_hash)
+        if data is not None:
+            self._mem.move_to_end(seq_hash)
+            self.stats.g2_hits += 1
+            return data
+        path = self._disk.get(seq_hash)
+        if path is not None:
+            try:
+                with np.load(path) as z:
+                    data = {"k": z["k"], "v": z["v"]}
+                    # bfloat16 round-trips as uint16 views (np.savez can't
+                    # serialise ml_dtypes natively)
+                    dtype = str(z["dtype"]) if "dtype" in z else None
+                if dtype and dtype != data["k"].dtype.name:
+                    import ml_dtypes
+
+                    dt = np.dtype(getattr(ml_dtypes, dtype, dtype))
+                    data = {n: a.view(dt) for n, a in data.items()}
+            except Exception:
+                log.exception("G3 read failed for %x", seq_hash)
+                self._disk.pop(seq_hash, None)
+                return None
+            self.stats.g3_hits += 1
+            self.put(seq_hash, data)  # promote back to G2
+            return data
+        self.stats.misses += 1
+        return None
+
+    # -- insert --
+
+    def put(self, seq_hash: int, data: Dict[str, np.ndarray]) -> None:
+        if seq_hash in self._mem:
+            self._mem.move_to_end(seq_hash)
+            return
+        self._mem[seq_hash] = data
+        while len(self._mem) > self.capacity:
+            old_hash, old_data = self._mem.popitem(last=False)
+            self._spill(old_hash, old_data)
+        self._refresh()
+
+    def _spill(self, seq_hash: int, data: Dict[str, np.ndarray]) -> None:
+        if self.disk_dir is None or self.disk_capacity <= 0:
+            self.stats.drops += 1
+            return
+        if seq_hash in self._disk:
+            return
+        path = self.disk_dir / f"{seq_hash:016x}.npz"
+        try:
+            k, v = data["k"], data["v"]
+            dtype = k.dtype.name
+            if k.dtype.kind not in "fiu":  # ml_dtypes (bfloat16 etc.)
+                k, v = k.view(np.uint16), v.view(np.uint16)
+            np.savez(path, k=k, v=v, dtype=dtype)
+        except Exception:
+            log.exception("G3 spill failed for %x", seq_hash)
+            return
+        self._disk[seq_hash] = path
+        self.stats.spills += 1
+        while len(self._disk) > self.disk_capacity:
+            _, old_path = self._disk.popitem(last=False)
+            try:
+                os.unlink(old_path)
+            except OSError:
+                pass
+        self._refresh()
+
+    def _refresh(self) -> None:
+        self.stats.g2_blocks = len(self._mem)
+        self.stats.g3_blocks = len(self._disk)

@@ -1,0 +1,152 @@
+"""KVBM manager: write-through offload + prefix-cache onboarding
+(ref: lib/llm/src/block_manager/offload.rs — priority-queued offload with
+transfer batching; block_manager.rs:99 ``KvBlockManager``).
+
+Lifecycle per block:
+
+  sealed in G1 ──(pending queue)──► batched gather → G2 host pool ─► G3 disk
+  evicted from G1, prompt needs it ──► adopt G1 block + batched scatter ◄──┘
+
+Offload runs in ``tick()``, called by the engine's step loop *between*
+steps: candidate hashes accumulate as the scheduler seals blocks, and one
+batched device gather copies up to ``max_offload_per_tick`` blocks per tick.
+Removed/cleared pool events invalidate pending candidates before each
+snapshot, so a gather never reads a recycled block (both run on the event
+loop; device work serialises on the engine's single step executor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..tokens import SequenceHash
+from ..utils.logging import get_logger
+from .host_pool import HostBlockPool
+
+log = get_logger("kvbm")
+
+
+@dataclass
+class KvbmConfig:
+    host_blocks: int = 1024          # G2 capacity
+    disk_dir: Optional[str] = None   # G3 location (None = no disk tier)
+    disk_blocks: int = 0             # G3 capacity
+    max_offload_per_tick: int = 32   # device-gather batch bound
+    max_onboard_blocks: int = 512    # per-request onboard bound
+
+
+@dataclass
+class KvbmStats:
+    offloaded_blocks: int = 0
+    onboarded_blocks: int = 0
+    onboard_requests: int = 0
+    invalidated_pending: int = 0
+
+
+@dataclass
+class _Pending:
+    seq_hash: int
+    block_hash: int
+    parent: Optional[int]
+    block_id: int
+
+
+class KvbmManager:
+    """Attached to an :class:`InferenceEngine` via ``attach_kvbm``."""
+
+    def __init__(self, engine, config: Optional[KvbmConfig] = None):
+        self.engine = engine
+        self.config = config or KvbmConfig()
+        self.host_pool = HostBlockPool(
+            self.config.host_blocks, self.config.disk_dir,
+            self.config.disk_blocks,
+        )
+        self.stats = KvbmStats()
+        # seq_hash -> candidate awaiting offload; insertion-ordered
+        self._pending: Dict[int, _Pending] = {}
+        self.block_size = engine.config.block_size
+
+    # ---- pool event hook (called synchronously from the scheduler) ----
+
+    def on_pool_event(self, event) -> None:
+        if event.kind == "stored":
+            for b in event.blocks:
+                h = b["seq_hash"]
+                if h not in self.host_pool and h not in self._pending:
+                    self._pending[h] = _Pending(
+                        seq_hash=h,
+                        block_hash=b.get("block_hash", h),
+                        parent=b.get("parent"),
+                        block_id=b["block_id"],
+                    )
+        elif event.kind == "removed":
+            for h in event.blocks:
+                if self._pending.pop(h, None) is not None:
+                    self.stats.invalidated_pending += 1
+        elif event.kind == "cleared":
+            self.stats.invalidated_pending += len(self._pending)
+            self._pending.clear()
+
+    # ------------------------- offload tick ----------------------------
+
+    async def tick(self) -> int:
+        """Offload up to ``max_offload_per_tick`` pending blocks in ONE
+        batched device gather. Returns blocks offloaded."""
+        if not self._pending:
+            return 0
+        batch: List[_Pending] = []
+        for h in list(self._pending):
+            batch.append(self._pending.pop(h))
+            if len(batch) >= self.config.max_offload_per_tick:
+                break
+        block_ids = [p.block_id for p in batch]
+        data = await self.engine.extract_kv_blocks(block_ids)
+        bs = self.block_size
+        for i, p in enumerate(batch):
+            self.host_pool.put(p.seq_hash, {
+                "k": data["k"][:, i * bs:(i + 1) * bs],
+                "v": data["v"][:, i * bs:(i + 1) * bs],
+            })
+        self.stats.offloaded_blocks += len(batch)
+        return len(batch)
+
+    # ------------------------- onboarding ------------------------------
+
+    async def onboard_prefix(self, token_seq) -> int:
+        """Promote host-held leading blocks of ``token_seq`` into the G1
+        prefix cache (adopt + one batched scatter). Returns blocks
+        onboarded. Called by the engine at admission, before scheduling."""
+        pool = self.engine.scheduler.pool
+        adopted: List[Tuple[int, Dict[str, np.ndarray]]] = []
+        try:
+            for tb in token_seq.blocks[: self.config.max_onboard_blocks]:
+                if pool.contains(tb.sequence_hash):
+                    continue  # native G1 hit — prefix matching will take it
+                data = self.host_pool.get(tb.sequence_hash)
+                if data is None:
+                    break  # chained hashes: deeper blocks can't hit either
+                bid = pool.adopt(
+                    tb.sequence_hash, tb.block_hash, tb.parent_sequence_hash
+                )
+                if bid is None:
+                    break  # G1 full — stop promoting
+                adopted.append((bid, data))
+            if not adopted:
+                return 0
+            block_ids = [bid for bid, _ in adopted]
+            data = {
+                "k": np.concatenate([d["k"] for _, d in adopted], axis=1),
+                "v": np.concatenate([d["v"] for _, d in adopted], axis=1),
+            }
+            await self.engine.inject_kv_blocks(block_ids, data)
+        finally:
+            for bid, _ in adopted:
+                pool.release_adopted(bid)
+        self.stats.onboarded_blocks += len(adopted)
+        if adopted:
+            self.stats.onboard_requests += 1
+            log.debug("onboarded %d blocks from host tier", len(adopted))
+        return len(adopted)

@@ -59,6 +59,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--min-remote-prefill-tokens", type=int, default=32)
+    p.add_argument("--kvbm-host-blocks", type=int, default=0,
+                   help="G2 host-tier capacity in blocks (0 = KVBM off)")
+    p.add_argument("--kvbm-disk-dir", default=None)
+    p.add_argument("--kvbm-disk-blocks", type=int, default=0)
     return p.parse_args(argv)
 
 
@@ -86,6 +90,14 @@ async def run_worker(args: argparse.Namespace) -> None:
     # seconds of synchronous JAX work (param init, device_put) that would
     # starve the lease keepalive and get the worker evicted at birth.
     engine = InferenceEngine(model_cfg, eng_cfg)
+    if args.kvbm_host_blocks > 0:
+        from .kvbm.manager import KvbmConfig
+
+        engine.attach_kvbm(KvbmConfig(
+            host_blocks=args.kvbm_host_blocks,
+            disk_dir=args.kvbm_disk_dir,
+            disk_blocks=args.kvbm_disk_blocks,
+        ))
     runtime = await DistributedRuntime.from_settings(config)
 
     handler = None

@@ -1,0 +1,160 @@
+"""KVBM multi-tier block manager: host/disk pools, write-through offload,
+onboarding, and the token-determinism property with tiering enabled
+(ref: tests/kvbm/test_determinism.py — identical outputs with and without
+offload tiers)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.kvbm.host_pool import HostBlockPool
+from dynamo_tpu.kvbm.manager import KvbmConfig
+
+pytestmark = pytest.mark.anyio
+
+
+def block(v, shape=(2, 4, 1, 4)):
+    return {"k": np.full(shape, v, np.float32),
+            "v": np.full(shape, -v, np.float32)}
+
+
+# --------------------------- host pool ---------------------------------
+
+
+def test_host_pool_lru_and_drop():
+    pool = HostBlockPool(capacity_blocks=2)
+    pool.put(1, block(1))
+    pool.put(2, block(2))
+    assert pool.get(1) is not None      # touch 1 → 2 becomes LRU
+    pool.put(3, block(3))               # evicts 2 (dropped, no disk)
+    assert 2 not in pool
+    assert pool.stats.drops == 1
+    assert pool.get(3)["k"][0, 0, 0, 0] == 3
+
+
+def test_host_pool_disk_spill_and_promote(tmp_path):
+    pool = HostBlockPool(capacity_blocks=1, disk_dir=str(tmp_path),
+                         disk_capacity_blocks=4)
+    pool.put(1, block(1))
+    pool.put(2, block(2))               # spills 1 to disk
+    assert pool.stats.spills == 1
+    assert 1 in pool
+    got = pool.get(1)                   # G3 hit, promoted back (evicts 2)
+    np.testing.assert_array_equal(got["k"], block(1)["k"])
+    assert pool.stats.g3_hits == 1
+
+
+def test_host_pool_disk_bf16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    data = {"k": np.ones((2, 4, 1, 4), bf16), "v": np.zeros((2, 4, 1, 4), bf16)}
+    pool = HostBlockPool(capacity_blocks=1, disk_dir=str(tmp_path),
+                         disk_capacity_blocks=2)
+    pool.put(7, data)
+    pool.put(8, block(8))               # spill 7
+    got = pool.get(7)
+    assert got["k"].dtype == bf16
+    np.testing.assert_array_equal(
+        got["k"].astype(np.float32), np.ones((2, 4, 1, 4), np.float32)
+    )
+
+
+# --------------------------- engine tiering ----------------------------
+
+
+def tiered_engine(num_blocks=24, host_blocks=64, **kvbm_kw):
+    """Deliberately tiny G1 so long prompts force eviction."""
+    eng = InferenceEngine(
+        ModelConfig.tiny(vocab_size=256),
+        EngineConfig(num_blocks=num_blocks, block_size=4, max_model_len=128,
+                     max_num_batched_tokens=128, prefill_buckets=(128,),
+                     decode_buckets=(4,), max_num_seqs=4),
+        seed=0,
+    )
+    eng.attach_kvbm(KvbmConfig(host_blocks=host_blocks, **kvbm_kw))
+    return eng
+
+
+async def run_request(engine, prompt, n=4):
+    toks = []
+    async for out in engine.submit(Request(
+        request_id=f"r{id(prompt) % 1000}-{len(prompt)}-{prompt[0]}",
+        token_ids=list(prompt), max_tokens=n, ignore_eos=True,
+    )):
+        toks.append(out.token_id)
+    return toks
+
+
+async def test_offload_and_onboard_roundtrip():
+    engine = tiered_engine()
+    prompt_a = list(range(1, 41))       # 10 blocks
+    first = await run_request(engine, prompt_a)
+    # idle drain offloads sealed blocks to the host tier
+    for _ in range(100):
+        if engine.kvbm.stats.offloaded_blocks >= 10:
+            break
+        await asyncio.sleep(0.05)
+    assert engine.kvbm.stats.offloaded_blocks >= 10
+
+    # evict A's blocks from G1 with filler traffic
+    for base in (50, 90, 130):
+        await run_request(engine, [base + i for i in range(40)])
+    pool = engine.scheduler.pool
+
+    # A's prefix is gone from G1 but must onboard from the host tier
+    again = await run_request(engine, prompt_a)
+    assert engine.kvbm.stats.onboarded_blocks > 0
+    assert again == first               # token-exact across tiers
+    await engine.stop()
+
+
+async def test_determinism_with_and_without_tiers():
+    """The reference's KVBM determinism property: outputs are identical
+    with tiering enabled (small G1 + host tier, heavy eviction) and with a
+    plain engine that never evicts."""
+    control = InferenceEngine(
+        ModelConfig.tiny(vocab_size=256),
+        EngineConfig(num_blocks=256, block_size=4, max_model_len=128,
+                     max_num_batched_tokens=128, prefill_buckets=(128,),
+                     decode_buckets=(4,), max_num_seqs=4),
+        seed=0,
+    )
+    tiered = tiered_engine(num_blocks=20)
+    prompts = [
+        list(range(1, 33)),
+        list(range(1, 33)) + [60, 61, 62, 63],   # shared prefix
+        [100 + i for i in range(28)],
+        list(range(1, 33)),                       # repeat of the first
+    ]
+    for p in prompts:
+        expected = await run_request(control, p)
+        got = await run_request(tiered, p)
+        assert got == expected, f"divergence on prompt {p[:4]}…"
+    await control.stop()
+    await tiered.stop()
+
+
+async def test_disk_tier_onboard(tmp_path):
+    """G2 sized below the working set so blocks spill to G3 and onboard
+    back from disk."""
+    engine = tiered_engine(num_blocks=20, host_blocks=4,
+                           disk_dir=str(tmp_path), disk_blocks=64)
+    prompt = list(range(1, 41))
+    first = await run_request(engine, prompt)
+    for _ in range(100):
+        if engine.kvbm.stats.offloaded_blocks >= 10:
+            break
+        await asyncio.sleep(0.05)
+    # push A out of both G1 (filler traffic) and G2 (tiny capacity)
+    for base in (60, 100, 140):
+        await run_request(engine, [base + i for i in range(40)])
+        await asyncio.sleep(0.2)
+    assert engine.kvbm.host_pool.stats.spills > 0
+    again = await run_request(engine, prompt)
+    assert engine.kvbm.host_pool.stats.g3_hits > 0
+    assert again == first
+    await engine.stop()
