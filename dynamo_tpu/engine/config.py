@@ -91,6 +91,10 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
     # sharding: (data, tensor) mesh axis sizes; (1, 1) = single chip
     mesh_shape: Tuple[int, int] = (1, 1)
+    # decode attention implementation: "pallas" streams KV blocks HBM→VMEM
+    # with online softmax (ops/paged_attention.py); "einsum" materialises the
+    # gathered context (the XLA-fusion reference path)
+    attention_impl: str = "pallas"
 
     def __post_init__(self):
         if self.max_num_seqs > max(self.decode_buckets):

@@ -66,11 +66,11 @@ def _bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
-def _pow2_bucket(n: int, cap: int) -> int:
+def _pow2_bucket(n: int, cap: Optional[int] = None) -> int:
     b = 1
     while b < n:
         b *= 2
-    return min(b, cap)
+    return b if cap is None else min(b, cap)
 
 
 class EngineCore(AsyncEngine):
@@ -157,13 +157,16 @@ class EngineCore(AsyncEngine):
         )
         if self.kvbm is not None:
             # promote host-tier prefix blocks into G1 before admission so
-            # the scheduler's prefix match serves them as native hits
+            # the scheduler's prefix match serves them as native hits;
+            # the token sequence is built once here and reused by the
+            # scheduler (hash-chaining the prompt is O(prompt_len))
             from ..tokens import TokenBlockSequence
 
+            seq.token_seq = TokenBlockSequence.from_tokens(
+                seq.prompt_ids, self.config.block_size
+            )
             try:
-                await self.kvbm.onboard_prefix(TokenBlockSequence.from_tokens(
-                    seq.prompt_ids, self.config.block_size
-                ))
+                await self.kvbm.onboard_prefix(seq.token_seq)
             except Exception:
                 log.exception("kvbm onboard failed — prefilling from scratch")
         queue: asyncio.Queue = asyncio.Queue()
@@ -329,13 +332,16 @@ class EngineCore(AsyncEngine):
                     self.scheduler.abort(seq, "error")
                     self._emit_finish(seq, "error")
                     continue
+                # clear BEFORE the kvbm drain: a submit() arriving during the
+                # drain's awaits sets _wake, which must survive to the wait()
+                self._wake.clear()
                 if self.kvbm is not None:
                     try:  # going idle: drain the offload backlog
-                        while await self.kvbm.tick():
+                        while (not self._wake.is_set()
+                               and await self.kvbm.tick()):
                             pass
                     except Exception:
                         log.exception("kvbm idle drain failed")
-                self._wake.clear()
                 if self._stopped:
                     return
                 await self._wake.wait()
@@ -481,28 +487,21 @@ class InferenceEngine(EngineCore):
     # step execution — the cache buffer is donated every step, so nothing
     # may touch it concurrently.
 
-    @staticmethod
-    def _pad_pow2(n: int) -> int:
-        b = 1
-        while b < n:
-            b *= 2
-        return b
-
     async def extract_kv_blocks(self, block_ids) -> Dict[str, np.ndarray]:
-        """Gather arbitrary physical blocks to host memory. The id list is
-        padded to a power of two (pads gather the trash block) so XLA
-        compiles O(log N) program variants, and the pad is sliced off."""
+        """Gather arbitrary physical blocks to host memory ([L, N, KV, bs,
+        hd]). The id list is padded to a power of two (pads gather the trash
+        block) so XLA compiles O(log N) program variants, and the pad is
+        sliced off."""
         loop = asyncio.get_running_loop()
         n = len(block_ids)
-        padded = np.zeros((self._pad_pow2(n),), np.int32)
+        padded = np.zeros((_pow2_bucket(n),), np.int32)
         padded[:n] = block_ids
-        bs = self.config.block_size
 
         def _ex():
             data = self._kv_extract(self.cache, padded)
             return {
-                "k": np.asarray(jax.device_get(data["k"]))[:, : n * bs],
-                "v": np.asarray(jax.device_get(data["v"]))[:, : n * bs],
+                "k": np.asarray(jax.device_get(data["k"]))[:, :n],
+                "v": np.asarray(jax.device_get(data["v"]))[:, :n],
             }
 
         return await loop.run_in_executor(self._executor, _ex)
@@ -514,13 +513,12 @@ class InferenceEngine(EngineCore):
         trash block, which absorbs garbage by design)."""
         loop = asyncio.get_running_loop()
         n = len(block_ids)
-        m = self._pad_pow2(n)
+        m = _pow2_bucket(n)
         padded = np.zeros((m,), np.int32)
         padded[:n] = block_ids
         if m != n:
-            bs = self.config.block_size
             pad_shape = list(data["k"].shape)
-            pad_shape[1] = (m - n) * bs
+            pad_shape[1] = m - n
             pad = np.zeros(pad_shape, data["k"].dtype)
             data = {
                 "k": np.concatenate([data["k"], pad], axis=1),

@@ -10,11 +10,12 @@ TPU-native. Design points:
   program per (B, T, W) bucket combination.
 - **Layers are scanned** (``lax.scan`` over stacked parameters) so compile
   time is O(1) in depth, and the KV cache is a single stacked array per K/V.
-- **Paged KV**: the cache is ``[L, num_blocks * block_size, KV, hd]``; the
-  step scatters the chunk's K/V into physical slots computed from the block
-  table, then gathers the sequence's blocks for attention. Physical block 0 is
-  a trash block — padding positions scatter there, and the allocator never
-  hands it out.
+- **Paged KV**: the cache is ``[L, num_blocks, KV, block_size, hd]``
+  (block-major, head-contiguous); the step scatters the chunk's K/V into
+  (block, offset) slots from the block table, then attends — decode via the
+  Pallas paged kernel streaming blocks HBM→VMEM (ops/paged_attention.py),
+  prefill via a gathered-context einsum. Physical block 0 is a trash block —
+  padding positions scatter there, and the allocator never hands it out.
 - **TP via shardings, not code**: parameters and cache carry
   ``jax.sharding.NamedSharding`` annotations over a ``("dp", "tp")`` mesh
   (attention/MLP column-row sharded, KV heads sharded over tp); XLA GSPMD
@@ -82,10 +83,16 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
 
 def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
-    """Paged KV cache: flat slot dimension = num_blocks * block_size."""
+    """Paged KV cache, block-major and head-contiguous:
+    ``[L, num_blocks, KV, block_size, hd]``.
+
+    One (block, head) tile is a contiguous ``bs*hd`` run — the DMA granule
+    the Pallas decode kernel streams HBM→VMEM, and the transfer unit for
+    disagg/KVBM block movement. (Also what makes the kernel's BlockSpec
+    legal: Mosaic requires the trailing two block dims to tile the array.)"""
     dt = _dtype(cfg)
-    slots = eng.num_blocks * eng.block_size
-    shape = (cfg.num_layers, slots, cfg.num_kv_heads, cfg.head_dim_)
+    shape = (cfg.num_layers, eng.num_blocks, cfg.num_kv_heads,
+             eng.block_size, cfg.head_dim_)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
@@ -125,7 +132,7 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
 
 def cache_shardings(mesh: Mesh) -> Cache:
     # KV heads sharded over tp so each shard holds the heads it computes
-    spec = NamedSharding(mesh, P(None, None, "tp", None))
+    spec = NamedSharding(mesh, P(None, None, "tp", None, None))
     return {"k": spec, "v": spec}
 
 
@@ -186,6 +193,47 @@ def _attention(
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
+def _paged_decode_attention(
+    eng: EngineConfig,
+    mesh: Optional[Mesh],
+    q: jax.Array,            # [B, 1, H, hd]
+    lk: jax.Array,           # [NB, KV, bs, hd] this layer's cache (updated)
+    lv: jax.Array,           # [NB, KV, bs, hd]
+    block_tables: jax.Array,  # [B, W]
+    seq_lens: jax.Array,      # [B] valid context incl. current token
+) -> jax.Array:
+    """Decode-path attention via the Pallas paged kernel ([B, 1, H, hd]).
+
+    When the cache is head-sharded over ``tp`` the kernel runs under
+    ``shard_map`` so each shard streams only its own KV heads — a bare
+    pallas_call is opaque to the GSPMD partitioner and would force an
+    all-gather of the whole cache.
+    """
+    from ..ops.paged_attention import paged_attention_decode
+
+    interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(
+        paged_attention_decode,
+        block_size=eng.block_size,
+        interpret=interpret,
+    )
+    q3 = q[:, 0]  # [B, H, hd]
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        out = jax.shard_map(
+            lambda q_, k_, v_, t_, s_: kernel(q_, k_, v_, t_, s_),
+            mesh=mesh,
+            in_specs=(
+                P(None, "tp", None), P(None, "tp", None, None),
+                P(None, "tp", None, None), P(None, None), P(None),
+            ),
+            out_specs=P(None, "tp", None),
+            check_vma=False,  # pallas_call outputs carry no vma info
+        )(q3, lk, lv, block_tables, seq_lens)
+    else:
+        out = kernel(q3, lk, lv, block_tables, seq_lens)
+    return out[:, None]
+
+
 def forward(
     cfg: ModelConfig,
     eng: EngineConfig,
@@ -194,6 +242,7 @@ def forward(
     tokens: jax.Array,        # [B, T] int32 (0 = pad)
     positions: jax.Array,     # [B, T] int32 absolute, -1 = pad
     block_tables: jax.Array,  # [B, W] int32 physical block ids (0 = trash)
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[Cache, jax.Array]:
     """Run the transformer over a token chunk, updating the paged cache.
 
@@ -207,24 +256,22 @@ def forward(
 
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
 
-    # physical slot index per (b, t); pads go to the trash block (block 0)
+    # physical (block, offset) per (b, t); pads go to the trash block 0
     pos_safe = jnp.maximum(positions, 0)
     logical_block = pos_safe // bs                      # [B, T]
     phys_block = jnp.take_along_axis(
         block_tables, jnp.minimum(logical_block, W - 1), axis=1
     )                                                   # [B, T]
-    slot = jnp.where(
-        positions >= 0, phys_block * bs + pos_safe % bs, 0
-    )                                                   # [B, T]
+    scatter_block = jnp.where(positions >= 0, phys_block, 0).reshape(-1)
+    scatter_off = jnp.where(positions >= 0, pos_safe % bs, 0).reshape(-1)
 
-    # flat gather indices for the sequence's whole context: [B, W*bs]
-    ctx_slots = (block_tables[:, :, None] * bs
-                 + jnp.arange(bs)[None, None, :]).reshape(B, W * bs)
+    use_pallas = T == 1 and eng.attention_impl == "pallas"
+    seq_lens = jnp.maximum(positions[:, 0] + 1, 0) if use_pallas else None
 
     def layer(carry, xs):
         h, cache_k, cache_v = carry
         p = xs  # this layer's stacked params + this layer's cache slice
-        lk, lv = p["cache_k"], p["cache_v"]   # [slots, KV, hd]
+        lk, lv = p["cache_k"], p["cache_v"]   # [NB, KV, bs, hd]
 
         x = _rms_norm(h, p["attn_norm"], cfg.rms_norm_eps)
         q = (x @ p["wq"]).reshape(B, T, H, hd)
@@ -234,17 +281,31 @@ def forward(
         k = _rope(k, positions, cfg.rope_theta)
 
         # scatter this chunk's K/V into the paged cache
-        lk = lk.at[slot.reshape(-1)].set(k.reshape(B * T, KV, hd))
-        lv = lv.at[slot.reshape(-1)].set(v.reshape(B * T, KV, hd))
+        lk = lk.at[scatter_block, :, scatter_off].set(
+            k.reshape(B * T, KV, hd)
+        )
+        lv = lv.at[scatter_block, :, scatter_off].set(
+            v.reshape(B * T, KV, hd)
+        )
 
-        # gather the full context for attention
-        k_all = jnp.take(lk, ctx_slots.reshape(-1), axis=0).reshape(
-            B, W * bs, KV, hd
-        )
-        v_all = jnp.take(lv, ctx_slots.reshape(-1), axis=0).reshape(
-            B, W * bs, KV, hd
-        )
-        attn = _attention(q, k_all, v_all, positions)
+        if use_pallas:
+            attn = _paged_decode_attention(
+                eng, mesh, q, lk, lv, block_tables, seq_lens
+            )
+        else:
+            # gather the full context for attention: [B, W*bs, KV, hd] with
+            # gathered position = w*bs + offset = absolute position
+            k_all = jnp.take(
+                lk, block_tables.reshape(-1), axis=0
+            ).reshape(B, W, KV, bs, hd).transpose(0, 1, 3, 2, 4).reshape(
+                B, W * bs, KV, hd
+            )
+            v_all = jnp.take(
+                lv, block_tables.reshape(-1), axis=0
+            ).reshape(B, W, KV, bs, hd).transpose(0, 1, 3, 2, 4).reshape(
+                B, W * bs, KV, hd
+            )
+            attn = _attention(q, k_all, v_all, positions)
         h = h + attn.reshape(B, T, H * hd) @ p["wo"]
 
         x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
@@ -299,7 +360,8 @@ def sample(
 # --------------------------- the step function ----------------------------
 
 
-def raw_step_fn(cfg: ModelConfig, eng: EngineConfig):
+def raw_step_fn(cfg: ModelConfig, eng: EngineConfig,
+                mesh: Optional[Mesh] = None):
     """The unjitted unified prefill/decode step.
 
     Signature:
@@ -314,7 +376,8 @@ def raw_step_fn(cfg: ModelConfig, eng: EngineConfig):
     def step(params, cache, tokens, positions, block_tables,
              last_idx, rng, temperature, top_k):
         cache, h = forward(
-            cfg, eng, params, cache, tokens, positions, block_tables
+            cfg, eng, params, cache, tokens, positions, block_tables,
+            mesh=mesh,
         )
         B = tokens.shape[0]
         h_last = h[jnp.arange(B), last_idx]          # [B, D]
@@ -330,7 +393,7 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
 
     params+cache carry their shardings from device_put; data args are small
     host arrays XLA replicates, so no explicit in_shardings are needed."""
-    return jax.jit(raw_step_fn(cfg, eng), donate_argnums=(1,))
+    return jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,))
 
 
 # ------------------------ KV block transfer ops ---------------------------
@@ -346,27 +409,23 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
 def make_kv_ops(eng: EngineConfig):
     """(extract, inject) jitted block gather/scatter over the paged cache.
 
-    extract(cache, block_ids[N]) -> {"k","v"}: [L, N*bs, KV, hd]
+    extract(cache, block_ids[N]) -> {"k","v"}: [L, N, KV, bs, hd]
     inject(cache, block_ids[N], data) -> cache  (donated, in-place scatter)
-    """
-    bs = eng.block_size
 
-    def _slots(block_ids: jax.Array) -> jax.Array:
-        return (block_ids[:, None] * bs
-                + jnp.arange(bs)[None, :]).reshape(-1)
+    In the block-major layout these are single-axis gathers/scatters over
+    whole contiguous blocks — XLA lowers them to block-granular DMA.
+    """
 
     def extract(cache: Cache, block_ids: jax.Array) -> Cache:
-        slots = _slots(block_ids)
         return {
-            "k": jnp.take(cache["k"], slots, axis=1),
-            "v": jnp.take(cache["v"], slots, axis=1),
+            "k": jnp.take(cache["k"], block_ids, axis=1),
+            "v": jnp.take(cache["v"], block_ids, axis=1),
         }
 
     def inject(cache: Cache, block_ids: jax.Array, data: Cache) -> Cache:
-        slots = _slots(block_ids)
         return {
-            "k": cache["k"].at[:, slots].set(data["k"]),
-            "v": cache["v"].at[:, slots].set(data["v"]),
+            "k": cache["k"].at[:, block_ids].set(data["k"]),
+            "v": cache["v"].at[:, block_ids].set(data["v"]),
         }
 
     return (

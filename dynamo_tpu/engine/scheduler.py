@@ -125,6 +125,19 @@ class BlockPool:
     def release_adopted(self, bid: int) -> None:
         self.decref(bid)  # refcount 0 + sealed → evictable (cached)
 
+    def discard_adopted(self, bid: int) -> None:
+        """Back out an ``adopt`` whose KV injection failed: unregister the
+        hash so the block can never be served as a prefix hit, then free it.
+        (Releasing it normally would poison the prefix cache with blocks
+        whose KV was never written.)"""
+        seq_hash = self._hash_of.pop(bid, None)
+        self._parent_of.pop(bid, None)
+        if seq_hash is not None and self._cached.get(seq_hash) == bid:
+            del self._cached[seq_hash]
+            self._emit(KvEvent("removed", [seq_hash]))
+        self._ref.pop(bid, None)
+        self._free.append(bid)
+
     def incref(self, bid: int) -> None:
         self._ref[bid] += 1
 
@@ -268,9 +281,10 @@ class Scheduler:
     # -- admission --
 
     def add(self, seq: SchedSeq) -> None:
-        seq.token_seq = TokenBlockSequence.from_tokens(
-            seq.prompt_ids, self.config.block_size
-        )
+        if seq.token_seq is None:  # the KVBM onboard path pre-builds it
+            seq.token_seq = TokenBlockSequence.from_tokens(
+                seq.prompt_ids, self.config.block_size
+            )
         self.waiting.append(seq)
 
     def abort(self, seq: SchedSeq, reason: str = "aborted") -> None:

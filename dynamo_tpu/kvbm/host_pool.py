@@ -2,7 +2,7 @@
 (ref: lib/llm/src/block_manager/pool/managed.rs — active/inactive pools
 with hash reuse; storage/disk.rs for the disk tier).
 
-A block's payload is its per-block KV: ``{"k","v"}: [L, bs, KV, hd]``
+A block's payload is its per-block KV: ``{"k","v"}: [L, KV, bs, hd]``
 numpy arrays. G2 is an LRU dict bounded by ``capacity_blocks``; overflow
 spills to G3 (one file per block under ``disk_dir``) when configured,
 else drops. Lookups check G2 then G3 (disk hits are re-promoted to G2).

@@ -104,11 +104,12 @@ class KvbmManager:
                 break
         block_ids = [p.block_id for p in batch]
         data = await self.engine.extract_kv_blocks(block_ids)
-        bs = self.block_size
         for i, p in enumerate(batch):
+            # copy each [L, KV, bs, hd] block out of the batched gather —
+            # a numpy view would pin the whole batch buffer in G2
             self.host_pool.put(p.seq_hash, {
-                "k": data["k"][:, i * bs:(i + 1) * bs],
-                "v": data["v"][:, i * bs:(i + 1) * bs],
+                "k": data["k"][:, i].copy(),
+                "v": data["v"][:, i].copy(),
             })
         self.stats.offloaded_blocks += len(batch)
         return len(batch)
@@ -138,13 +139,18 @@ class KvbmManager:
                 return 0
             block_ids = [bid for bid, _ in adopted]
             data = {
-                "k": np.concatenate([d["k"] for _, d in adopted], axis=1),
-                "v": np.concatenate([d["v"] for _, d in adopted], axis=1),
+                "k": np.stack([d["k"] for _, d in adopted], axis=1),
+                "v": np.stack([d["v"] for _, d in adopted], axis=1),
             }
             await self.engine.inject_kv_blocks(block_ids, data)
-        finally:
+        except BaseException:
+            # injection failed (device error / caller cancelled): the
+            # adopted blocks hold no valid KV — discard, never cache them
             for bid, _ in adopted:
-                pool.release_adopted(bid)
+                pool.discard_adopted(bid)
+            raise
+        for bid, _ in adopted:
+            pool.release_adopted(bid)
         self.stats.onboarded_blocks += len(adopted)
         if adopted:
             self.stats.onboard_requests += 1

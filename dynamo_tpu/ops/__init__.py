@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+Role-equivalent to the reference's CUDA kernels (ref: lib/llm/src/kernels/
+block_copy.cu) plus the attention kernels the reference inherits from its
+engines (vLLM paged attention).  Everything here is written against the
+paged-KV layout owned by :mod:`dynamo_tpu.engine.model`.
+"""
+
+from .paged_attention import paged_attention_decode
+
+__all__ = ["paged_attention_decode"]

@@ -1,0 +1,161 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The decode step is the serving hot loop: every running sequence attends over
+its full paged context once per generated token.  The einsum path in
+``engine.model.forward`` first *materialises* the gathered context
+(``[B, W*bs, KV, hd]`` in HBM) and then runs attention over it — two passes
+over the context bytes.  This kernel streams each sequence's KV blocks
+HBM→VMEM exactly once, driven by the block table, with flash-attention-style
+online softmax so nothing is materialised.
+
+Mechanics (the TPU-idiomatic part): the grid is ``(B, KV, W)`` and the block
+tables + context lengths ride ``PrefetchScalarGridSpec`` scalar prefetch, so
+the K/V ``BlockSpec`` index maps *read the block table* to pick which
+physical block Mosaic DMAs next — the pipeline does the paged gather for
+free, double-buffered, overlapping the previous block's FLOPs.
+
+Role-equivalent to the paged-attention CUDA kernels inside the reference's
+engines (vLLM); the reference itself ships only block-copy kernels
+(ref: lib/llm/src/kernels/block_copy.cu:41).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,    # [B, W] int32 physical block ids
+    seq_lens_ref,  # [B] int32 context length (incl. current token)
+    # blocks
+    q_ref,         # [1, 1, G, hd]
+    k_ref,         # [1, 1, bs, hd]
+    v_ref,         # [1, 1, bs, hd]
+    o_ref,         # [1, 1, G, hd]
+    # scratch
+    m_ref,         # [G, 1] f32 running max
+    l_ref,         # [G, 1] f32 running denominator
+    acc_ref,       # [G, hd] f32 running numerator
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+    num_w = pl.num_programs(2)
+    seq_len = seq_lens_ref[b]
+
+    @pl.when(w == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Only blocks that hold context tokens contribute.
+    @pl.when(w * block_size < seq_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)              # [bs, hd]
+        v = v_ref[0, 0].astype(jnp.float32)              # [bs, hd]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                         # [G, bs]
+
+        kpos = w * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        s = jnp.where(kpos < seq_len, s, -jnp.inf)
+
+        m_prev = m_ref[...]                               # [G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [G, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # m_new can only be -inf while no valid key has been seen; the
+        # guard keeps exp() finite for fully-masked blocks.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
+                                  -jnp.inf))             # [G, 1]
+        p = jnp.exp(s - m_safe)                           # [G, bs]
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # [G, hd]
+
+    @pl.when(w == num_w - 1)
+    def _finalize():
+        l = l_ref[...]
+        # Zero-length (padding) rows produce l == 0 → emit zeros, not NaN.
+        out = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "interpret")
+)
+def paged_attention_decode(
+    q: jax.Array,          # [B, H, hd]
+    k_cache: jax.Array,    # [num_blocks, KV, bs, hd] block-major paged cache
+    v_cache: jax.Array,    # [num_blocks, KV, bs, hd]
+    block_tables: jax.Array,  # [B, W] int32 (0 = trash block)
+    seq_lens: jax.Array,      # [B] int32 (0 = padding row)
+    *,
+    block_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-token-per-sequence paged attention.  Returns ``[B, H, hd]``.
+
+    ``seq_lens[b]`` counts the valid context slots for row ``b`` *including*
+    the token being decoded (whose K/V must already be scattered into the
+    cache, which is how ``engine.model.forward`` orders things).
+    """
+    B, H, hd = q.shape
+    KV = k_cache.shape[1]
+    G = H // KV
+    W = block_tables.shape[1]
+    bs = block_size
+
+    q4 = q.reshape(B, KV, G, hd)
+
+    grid = (B, KV, W)
+
+    def q_map(b, kv, w, tables, lens):
+        return (b, kv, 0, 0)
+
+    def kv_map(b, kv, w, tables, lens):
+        return (tables[b, w], kv, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), q_map),
+            pl.BlockSpec((1, 1, bs, hd), kv_map),
+            pl.BlockSpec((1, 1, bs, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, hd), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(
+        _decode_kernel, block_size=bs, scale=1.0 / (hd ** 0.5)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, seq_lens, q4, k_cache, v_cache)
+    return out.reshape(B, H, hd)
