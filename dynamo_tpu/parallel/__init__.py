@@ -1,0 +1,17 @@
+"""Model-parallelism building blocks: SP/CP ring attention, Ulysses
+all-to-all attention, expert parallelism, pipeline parallelism.
+
+The reference delegates intra-model parallelism to its engines (SURVEY §2.3:
+TP/PP/EP via vLLM/SGLang flags; SP/CP absent upstream) — here the engine is
+ours, so these are first-class TPU-native implementations over
+``jax.sharding.Mesh`` axes.
+"""
+
+from .ring_attention import make_ring_attention, ring_attention
+from .ulysses import make_ulysses_attention
+
+__all__ = [
+    "ring_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+]
