@@ -32,6 +32,7 @@ class ModelConfig:
     # MoE (0 experts = dense). gpt-oss-class models set these.
     num_experts: int = 0
     num_experts_per_token: int = 0
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim_(self) -> int:
@@ -61,6 +62,25 @@ class ModelConfig:
             hidden_size=2048, intermediate_size=8192, num_layers=16,
             num_heads=32, num_kv_heads=8, head_dim=64,
             tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "ModelConfig":
+        """Mixtral-class MoE shapes (8 experts, top-2 routing)."""
+        return ModelConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8,
+            rope_theta=1e6, num_experts=8, num_experts_per_token=2,
+        )
+
+    @staticmethod
+    def tiny_moe(vocab_size: int = 512) -> "ModelConfig":
+        """CPU-testable MoE toy (8 experts over an 8-way mesh)."""
+        return ModelConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=8, head_dim=8,
+            max_position=512, rope_theta=10000.0, dtype="float32",
+            num_experts=8, num_experts_per_token=2,
         )
 
     @staticmethod

@@ -62,19 +62,27 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         return (jax.random.normal(key, shape, jnp.float32)
                 / np.sqrt(fan_in)).astype(dt)
 
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": norm(keys[1], (L, D, H * hd), D),
+        "wk": norm(keys[2], (L, D, KV * hd), D),
+        "wv": norm(keys[3], (L, D, KV * hd), D),
+        "wo": norm(keys[4], (L, H * hd, D), H * hd),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["w_router"] = norm(keys[9], (L, D, E), D)
+        layers["w_gate"] = norm(keys[5], (L, E, D, F), D)
+        layers["w_up"] = norm(keys[6], (L, E, D, F), D)
+        layers["w_down"] = norm(keys[7], (L, E, F, D), F)
+    else:
+        layers["w_gate"] = norm(keys[5], (L, D, F), D)
+        layers["w_up"] = norm(keys[6], (L, D, F), D)
+        layers["w_down"] = norm(keys[7], (L, F, D), F)
     params: Params = {
         "embed": norm(keys[0], (V, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dt),
-            "wq": norm(keys[1], (L, D, H * hd), D),
-            "wk": norm(keys[2], (L, D, KV * hd), D),
-            "wv": norm(keys[3], (L, D, KV * hd), D),
-            "wo": norm(keys[4], (L, H * hd, D), H * hd),
-            "mlp_norm": jnp.ones((L, D), dt),
-            "w_gate": norm(keys[5], (L, D, F), D),
-            "w_up": norm(keys[6], (L, D, F), D),
-            "w_down": norm(keys[7], (L, F, D), F),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dt),
     }
     if not cfg.tie_word_embeddings:
@@ -110,19 +118,28 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    layers: Params = {
+        "attn_norm": s(None, None),
+        "wq": s(None, None, "tp"),
+        "wk": s(None, None, "tp"),
+        "wv": s(None, None, "tp"),
+        "wo": s(None, "tp", None),
+        "mlp_norm": s(None, None),
+    }
+    if cfg.is_moe:
+        # expert parallelism: experts sharded over the model axis; the
+        # dispatch/combine einsums become all-to-alls under GSPMD
+        layers["w_router"] = s(None, None, None)
+        layers["w_gate"] = s(None, "tp", None, None)
+        layers["w_up"] = s(None, "tp", None, None)
+        layers["w_down"] = s(None, "tp", None, None)
+    else:
+        layers["w_gate"] = s(None, None, "tp")
+        layers["w_up"] = s(None, None, "tp")
+        layers["w_down"] = s(None, "tp", None)
     shardings: Params = {
         "embed": s(None, None),
-        "layers": {
-            "attn_norm": s(None, None),
-            "wq": s(None, None, "tp"),
-            "wk": s(None, None, "tp"),
-            "wv": s(None, None, "tp"),
-            "wo": s(None, "tp", None),
-            "mlp_norm": s(None, None),
-            "w_gate": s(None, None, "tp"),
-            "w_up": s(None, None, "tp"),
-            "w_down": s(None, "tp", None),
-        },
+        "layers": layers,
         "final_norm": s(None),
     }
     if not cfg.tie_word_embeddings:
@@ -309,9 +326,21 @@ def forward(
         h = h + attn.reshape(B, T, H * hd) @ p["wo"]
 
         x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
-        up = (x @ p["w_up"]).astype(jnp.float32)
-        h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+        if cfg.is_moe:
+            from ..parallel.moe import moe_ffn
+
+            D = x.shape[-1]
+            out = moe_ffn(
+                x.reshape(B * T, D),
+                p["w_router"], p["w_gate"], p["w_up"], p["w_down"],
+                top_k=cfg.num_experts_per_token,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+            h = h + out.reshape(B, T, D)
+        else:
+            gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
+            up = (x @ p["w_up"]).astype(jnp.float32)
+            h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
         return (h, cache_k, cache_v), (lk, lv)
 
     # lax.scan over layers: stacked params zipped with per-layer cache slices

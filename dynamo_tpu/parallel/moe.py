@@ -1,0 +1,87 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+WideEP-class capability (ref: the reference's pass-through EP flags,
+components/backends/sglang/docs/dsr1-wideep-h100.md — engine-internal there,
+first-class here). GShard-style capacity-based dispatch, built entirely from
+one-hot matmuls and batched einsums so everything lands on the MXU and the
+GSPMD partitioner shards it over the expert mesh axis with automatic
+all-to-alls — no per-token gather/scatter, no dynamic shapes.
+
+Sharding contract: expert-stacked weights ``[E, D, F]`` carry
+``P("ep"|"tp", None, None)``; the dispatch/combine einsums contract over the
+token axis, so XLA materialises per-expert buffers ``[E, C, D]`` sharded over
+E — each device computes only its experts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token capacity (static at trace time)."""
+    return max(1, math.ceil(num_tokens * top_k / num_experts
+                            * capacity_factor))
+
+
+def moe_ffn(
+    x: jax.Array,          # [N, D] tokens (flattened batch)
+    w_router: jax.Array,   # [D, E]
+    w_gate: jax.Array,     # [E, D, F]
+    w_up: jax.Array,       # [E, D, F]
+    w_down: jax.Array,     # [E, F, D]
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """Top-k routed SwiGLU experts; returns [N, D].
+
+    Tokens overflowing an expert's capacity lose that expert's contribution
+    (their combine weight is zeroed and the rest renormalised) — standard
+    GShard semantics; raise ``capacity_factor`` for exactness.
+    """
+    N, D = x.shape
+    E = w_router.shape[1]
+    C = moe_capacity(N, E, top_k, capacity_factor)
+    dt = x.dtype
+
+    logits = (x.astype(jnp.float32) @ w_router.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)      # [N, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    # one-hot expert assignment per (token, slot): [N, k, E]
+    assign = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+    # position of each (token, slot) within its expert's capacity buffer:
+    # running count of prior assignments to the same expert, flattened over
+    # (token-major, slot-minor) order
+    flat = assign.reshape(N * top_k, E)
+    pos = jnp.cumsum(flat, axis=0) - flat                # [N*k, E]
+    pos = jnp.sum(pos * flat, axis=-1).reshape(N, top_k)  # [N, k]
+    in_cap = pos < C
+    gates = jnp.where(in_cap, top_vals, 0.0)             # [N, k]
+
+    # dispatch tensor [N, E, C]: token n -> (expert, capacity slot)
+    pos_hot = jax.nn.one_hot(
+        jnp.where(in_cap, pos, C), C, dtype=jnp.float32
+    )                                                     # [N, k, C]
+    dispatch = jnp.einsum("nke,nkc->nec", assign, pos_hot)
+    combine = jnp.einsum("nke,nkc,nk->nec", assign, pos_hot, gates)
+
+    xin = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+    xin = xin.astype(dt)                                  # [E, C, D]
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", xin, w_gate).astype(jnp.float32)
+    )
+    up = jnp.einsum("ecd,edf->ecf", xin, w_up).astype(jnp.float32)
+    h = (gate * up).astype(dt)
+    out = jnp.einsum("ecf,efd->ecd", h, w_down)           # [E, C, D]
+    return jnp.einsum(
+        "nec,ecd->nd", combine, out.astype(jnp.float32)
+    ).astype(dt)
