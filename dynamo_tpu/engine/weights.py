@@ -1,0 +1,212 @@
+"""Model weight loading: HF safetensors → stacked scan params, plus orbax
+native checkpoints.
+
+Role-equivalent to the weight-loading path inside the reference's engines
+(vLLM loads HF checkpoints; the reference itself only ships the model card,
+ref: lib/llm/src/model_card.rs:93). Our scan-stacked layout wants every
+per-layer leaf stacked on a leading L axis, and JAX matmul orientation
+``x @ W`` wants HF's ``[out, in]`` Linear weights transposed.
+
+Dense (Llama 2/3) and MoE (Mixtral-style ``block_sparse_moe``) checkpoints
+are supported. Loading streams tensor-by-tensor from the safetensors
+memory map into preallocated stacked buffers — peak host memory is one
+stacked leaf, not two copies of the checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+from .config import ModelConfig
+
+log = get_logger("engine.weights")
+
+Params = Dict[str, Any]
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _stacked_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
+    hd = cfg.head_dim_
+    D, H, KV, F, L, V, E = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
+        cfg.num_experts,
+    )
+    layers = {
+        "attn_norm": (L, D),
+        "wq": (L, D, H * hd),
+        "wk": (L, D, KV * hd),
+        "wv": (L, D, KV * hd),
+        "wo": (L, H * hd, D),
+        "mlp_norm": (L, D),
+    }
+    if cfg.is_moe:
+        layers.update({
+            "w_router": (L, D, E),
+            "w_gate": (L, E, D, F),
+            "w_up": (L, E, D, F),
+            "w_down": (L, E, F, D),
+        })
+    else:
+        layers.update({
+            "w_gate": (L, D, F),
+            "w_up": (L, D, F),
+            "w_down": (L, F, D),
+        })
+    return layers
+
+
+def _dest(cfg: ModelConfig, name: str):
+    """Map an HF tensor name to (leaf_path, layer_idx, expert_idx,
+    transpose). Returns None for tensors we ignore (rotary inv_freq etc.)."""
+    if name == "model.embed_tokens.weight":
+        return ("embed", None, None, False)
+    if name == "model.norm.weight":
+        return ("final_norm", None, None, False)
+    if name == "lm_head.weight":
+        if cfg.tie_word_embeddings:
+            return None
+        return ("lm_head", None, None, True)
+    if not name.startswith("model.layers."):
+        return None
+    rest = name[len("model.layers."):]
+    idx, _, sub = rest.partition(".")
+    i = int(idx)
+    table = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+        "block_sparse_moe.gate.weight": ("w_router", True),
+    }
+    if sub in table:
+        leaf, t = table[sub]
+        return (leaf, i, None, t)
+    if sub.startswith("block_sparse_moe.experts."):
+        erest = sub[len("block_sparse_moe.experts."):]
+        eidx, _, ew = erest.partition(".")
+        e = int(eidx)
+        # Mixtral: w1 = gate, w3 = up, w2 = down
+        emap = {"w1.weight": "w_gate", "w3.weight": "w_up",
+                "w2.weight": "w_down"}
+        if ew in emap:
+            return (emap[ew], i, e, True)
+    return None
+
+
+def load_hf_params(path: str, cfg: ModelConfig) -> Params:
+    """Load an HF-format checkpoint directory (``*.safetensors``) into the
+    stacked scan param tree, cast to ``cfg.dtype``."""
+    from safetensors import safe_open
+
+    path = Path(path)
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    dt = _np_dtype(cfg.dtype)
+
+    layers = {
+        k: np.zeros(shape, dt) for k, shape in _stacked_shapes(cfg).items()
+    }
+    top: Dict[str, np.ndarray] = {}
+    seen = set()
+
+    for f in files:
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                dest = _dest(cfg, name)
+                if dest is None:
+                    continue
+                leaf, i, e, transpose = dest
+                t = sf.get_tensor(name)
+                if t.dtype == np.uint16:  # safetensors numpy bf16 fallback
+                    import ml_dtypes
+
+                    t = t.view(ml_dtypes.bfloat16)
+                if transpose:
+                    t = t.T
+                t = t.astype(dt, copy=False)
+                if i is None:
+                    top[leaf] = np.asarray(t)
+                elif e is None:
+                    layers[leaf][i] = t
+                else:
+                    layers[leaf][i, e] = t
+                seen.add((leaf, i, e))
+
+    params: Params = {
+        "embed": top["embed"],
+        "layers": layers,
+        "final_norm": top["final_norm"],
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = top["lm_head"]
+    log.info("loaded %d tensors from %s (%d files)",
+             len(seen), path, len(files))
+    return {k: jnp.asarray(v) if not isinstance(v, dict)
+            else {kk: jnp.asarray(vv) for kk, vv in v.items()}
+            for k, v in params.items()}
+
+
+def model_config_from_hf(path: str) -> ModelConfig:
+    """Build a ModelConfig from an HF ``config.json``."""
+    with open(Path(path) / "config.json") as f:
+        c = json.load(f)
+    return ModelConfig(
+        vocab_size=c["vocab_size"],
+        hidden_size=c["hidden_size"],
+        intermediate_size=c["intermediate_size"],
+        num_layers=c["num_hidden_layers"],
+        num_heads=c["num_attention_heads"],
+        num_kv_heads=c.get("num_key_value_heads",
+                           c["num_attention_heads"]),
+        head_dim=c.get("head_dim"),
+        rope_theta=c.get("rope_theta", 10000.0),
+        rms_norm_eps=c.get("rms_norm_eps", 1e-5),
+        max_position=c.get("max_position_embeddings", 8192),
+        tie_word_embeddings=c.get("tie_word_embeddings", False),
+        num_experts=c.get("num_local_experts", 0),
+        num_experts_per_token=c.get("num_experts_per_tok", 0),
+    )
+
+
+# --------------------------- orbax checkpoints ----------------------------
+
+
+def save_checkpoint(path: str, params: Params) -> None:
+    """Write a native orbax checkpoint (sharded-restore capable)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, target: Optional[Params] = None) -> Params:
+    """Restore an orbax checkpoint; pass ``target`` (e.g. abstract arrays
+    with shardings) to restore directly onto a device mesh."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        return ckptr.restore(os.path.abspath(path), target)
+    return ckptr.restore(os.path.abspath(path))

@@ -40,6 +40,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="served model name (default: preset name)")
     p.add_argument("--tokenizer", default=None,
                    help="tokenizer.json path or HF model dir")
+    p.add_argument("--weights", default=None,
+                   help="HF checkpoint dir (*.safetensors [+ config.json, "
+                        "which overrides --model]; tokenizer defaults to "
+                        "the same dir)")
     p.add_argument("--store-addr", default=None)
     p.add_argument("--namespace", default=None)
     p.add_argument("--component", default="backend")
@@ -75,6 +79,17 @@ async def run_worker(args: argparse.Namespace) -> None:
 
     dp, tp = (int(x) for x in args.mesh.split(","))
     model_cfg = MODEL_PRESETS[args.model]()
+    params = None
+    if args.weights:
+        import os
+
+        from .engine.weights import load_hf_params, model_config_from_hf
+
+        if os.path.exists(os.path.join(args.weights, "config.json")):
+            model_cfg = model_config_from_hf(args.weights)
+        params = load_hf_params(args.weights, model_cfg)
+        if args.tokenizer is None:
+            args.tokenizer = args.weights
     eng_cfg = EngineConfig(
         block_size=args.block_size,
         num_blocks=args.num_blocks,
@@ -89,7 +104,7 @@ async def run_worker(args: argparse.Namespace) -> None:
     # Build the engine BEFORE taking the store lease: engine construction is
     # seconds of synchronous JAX work (param init, device_put) that would
     # starve the lease keepalive and get the worker evicted at birth.
-    engine = InferenceEngine(model_cfg, eng_cfg)
+    engine = InferenceEngine(model_cfg, eng_cfg, params=params)
     if args.kvbm_host_blocks > 0:
         from .kvbm.manager import KvbmConfig
 

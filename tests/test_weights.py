@@ -1,0 +1,92 @@
+"""Weight loading: HF safetensors round-trip + orbax checkpoint round-trip."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import model as model_lib
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.weights import (
+    load_checkpoint, load_hf_params, model_config_from_hf, save_checkpoint,
+)
+
+
+def _write_hf_checkpoint(path, cfg, params):
+    """Inverse-map stacked params to HF tensor names (the test oracle)."""
+    from safetensors.numpy import save_file
+
+    def c(x):  # save_file silently mis-writes non-contiguous views
+        return np.ascontiguousarray(x)
+
+    L = cfg.num_layers
+    lay = params["layers"]
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]),
+        "model.norm.weight": np.asarray(params["final_norm"]),
+    }
+    if not cfg.tie_word_embeddings:
+        tensors["lm_head.weight"] = c(np.asarray(params["lm_head"]).T)
+    for i in range(L):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.asarray(lay["attn_norm"][i])
+        tensors[p + "self_attn.q_proj.weight"] = c(np.asarray(lay["wq"][i]).T)
+        tensors[p + "self_attn.k_proj.weight"] = c(np.asarray(lay["wk"][i]).T)
+        tensors[p + "self_attn.v_proj.weight"] = c(np.asarray(lay["wv"][i]).T)
+        tensors[p + "self_attn.o_proj.weight"] = c(np.asarray(lay["wo"][i]).T)
+        tensors[p + "post_attention_layernorm.weight"] = np.asarray(
+            lay["mlp_norm"][i])
+        if cfg.is_moe:
+            tensors[p + "block_sparse_moe.gate.weight"] = c(np.asarray(
+                lay["w_router"][i]).T)
+            for e in range(cfg.num_experts):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                tensors[ep + "w1.weight"] = c(np.asarray(lay["w_gate"][i, e]).T)
+                tensors[ep + "w3.weight"] = c(np.asarray(lay["w_up"][i, e]).T)
+                tensors[ep + "w2.weight"] = c(np.asarray(lay["w_down"][i, e]).T)
+        else:
+            tensors[p + "mlp.gate_proj.weight"] = c(np.asarray(
+                lay["w_gate"][i]).T)
+            tensors[p + "mlp.up_proj.weight"] = c(np.asarray(lay["w_up"][i]).T)
+            tensors[p + "mlp.down_proj.weight"] = c(np.asarray(
+                lay["w_down"][i]).T)
+    save_file(tensors, str(path / "model.safetensors"))
+
+
+def _assert_tree_equal(a, b):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("cfg_fn", [ModelConfig.tiny, ModelConfig.tiny_moe])
+def test_hf_safetensors_roundtrip(tmp_path, cfg_fn):
+    cfg = cfg_fn()
+    params = model_lib.init_params(jax.random.PRNGKey(0), cfg)
+    _write_hf_checkpoint(tmp_path, cfg, params)
+    loaded = load_hf_params(str(tmp_path), cfg)
+    _assert_tree_equal(params, loaded)
+
+
+def test_model_config_from_hf(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": 512, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 8,
+        "num_key_value_heads": 4, "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5, "max_position_embeddings": 512,
+        "tie_word_embeddings": True,
+    }))
+    cfg = model_config_from_hf(str(tmp_path))
+    assert cfg.num_kv_heads == 4 and cfg.tie_word_embeddings
+    assert not cfg.is_moe
+
+
+def test_orbax_roundtrip(tmp_path):
+    cfg = ModelConfig.tiny()
+    params = model_lib.init_params(jax.random.PRNGKey(0), cfg)
+    save_checkpoint(str(tmp_path / "ckpt"), params)
+    restored = load_checkpoint(str(tmp_path / "ckpt"))
+    _assert_tree_equal(params, restored)
