@@ -34,6 +34,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--router-mode", default="round_robin",
         choices=["round_robin", "random", "kv"],
     )
+    p.add_argument(
+        "--stats-publish-interval", type=float, default=10.0,
+        help="seconds between frontend_stats publishes for the planner "
+             "(0 disables)",
+    )
     return p.parse_args(argv)
 
 
@@ -84,6 +89,26 @@ async def run_frontend(args: argparse.Namespace) -> None:
     await watcher.start()
     await service.start()
 
+    stats_task = None
+    if args.stats_publish_interval > 0:
+        import msgpack
+
+        subject = f"{runtime.namespace().name}/frontend_stats"
+
+        async def _publish_stats():
+            while True:
+                await asyncio.sleep(args.stats_publish_interval)
+                win = service.window_stats.drain()
+                win["interval_s"] = args.stats_publish_interval
+                try:
+                    await runtime.store.publish(
+                        subject, msgpack.packb(win)
+                    )
+                except Exception:
+                    log.exception("frontend stats publish failed")
+
+        stats_task = asyncio.create_task(_publish_stats())
+
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(
@@ -91,6 +116,8 @@ async def run_frontend(args: argparse.Namespace) -> None:
         )
 
     async def _shutdown():
+        if stats_task is not None:
+            stats_task.cancel()
         await watcher.stop()
         await service.stop()
         await runtime.shutdown()

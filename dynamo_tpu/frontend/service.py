@@ -68,6 +68,40 @@ class ModelManager:
         return name in self._models
 
 
+class WindowStats:
+    """Per-window request aggregates for the SLA planner
+    (ref: the Prometheus series planner_core.py:193 observe_metrics pulls;
+    here collected in-process and published on the store)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.num_requests = 0
+        self.isl_sum = 0
+        self.osl_sum = 0
+        self.ttft_sum = 0.0
+        self.ttft_count = 0
+        self.itl_sum = 0.0
+        self.itl_count = 0
+
+    def drain(self) -> dict:
+        """Snapshot + reset; averages are None when nothing was observed."""
+        out = {
+            "num_requests": self.num_requests,
+            "isl_avg": (self.isl_sum / self.num_requests
+                        if self.num_requests else None),
+            "osl_avg": (self.osl_sum / self.num_requests
+                        if self.num_requests else None),
+            "ttft_avg_s": (self.ttft_sum / self.ttft_count
+                           if self.ttft_count else None),
+            "itl_avg_s": (self.itl_sum / self.itl_count
+                          if self.itl_count else None),
+        }
+        self.reset()
+        return out
+
+
 class HttpService:
     def __init__(
         self,
@@ -97,6 +131,7 @@ class HttpService:
         self._m_duration = m.histogram(
             "request_seconds", "request duration", ["model"]
         )
+        self.window_stats = WindowStats()
         self._runner: Optional[web.AppRunner] = None
         self.app = self._build_app()
 
@@ -254,15 +289,26 @@ class HttpService:
     ) -> AsyncIterator[BackendOutput]:
         first = True
         prev = None
+        ws = self.window_stats
+        n_out = 0
         async for out in outputs:
             now = time.monotonic()
             if first:
                 self._m_ttft.labels(model=model).observe(now - t0)
+                ws.ttft_sum += now - t0
+                ws.ttft_count += 1
+                ws.isl_sum += out.num_prompt_tokens
                 first = False
             elif prev is not None:
                 self._m_itl.labels(model=model).observe(now - prev)
+                ws.itl_sum += now - prev
+                ws.itl_count += 1
             prev = now
+            n_out += 1
             yield out
+        if not first:
+            ws.num_requests += 1
+            ws.osl_sum += n_out
 
     def _err(self, status: int, msg: str, model: str, endpoint: str) -> web.Response:
         self._m_requests.labels(

@@ -1,0 +1,20 @@
+"""SLA-based autoscaling planner (ref: components/planner — planner_core.py,
+perf_interpolation.py, load_predictor.py, virtual_connector.py).
+
+Observes frontend/worker metrics, predicts the next window's load, converts
+it into prefill/decode replica counts via pre-profiled perf interpolation,
+and emits scaling decisions through a connector (store-backed virtual
+connector here; a k8s connector is the deploy-layer analog).
+"""
+
+from .connector import VirtualConnector
+from .core import Planner, PlannerConfig, WindowMetrics
+from .interpolation import DecodeInterpolator, PrefillInterpolator
+from .predictors import ARPredictor, ConstantPredictor, MovingAveragePredictor
+
+__all__ = [
+    "Planner", "PlannerConfig", "WindowMetrics",
+    "PrefillInterpolator", "DecodeInterpolator",
+    "ConstantPredictor", "MovingAveragePredictor", "ARPredictor",
+    "VirtualConnector",
+]

@@ -1,0 +1,119 @@
+"""Planner process: subscribe to frontend window stats, emit scaling targets
+(ref: components/planner/src/dynamo/planner — start_sla_planner).
+
+    python -m dynamo_tpu.planner --profile profile.json \
+        --ttft 0.5 --itl 0.05 --adjustment-interval 30
+
+The profile file carries the SLA profiler's curves (see
+``dynamo_tpu.planner.interpolation`` for the keys). Targets are written to
+the store under ``planner/{namespace}/target/*`` (virtual connector); an
+orchestrator realises them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+import msgpack
+
+from ..runtime.component import DistributedRuntime
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+from .connector import VirtualConnector
+from .core import Planner, PlannerConfig, WindowMetrics
+from .interpolation import DecodeInterpolator, PrefillInterpolator
+
+log = get_logger("planner.main")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu SLA planner")
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--profile", required=True,
+                   help="JSON file with profiled perf curves")
+    p.add_argument("--ttft", type=float, default=0.5, help="TTFT SLA (s)")
+    p.add_argument("--itl", type=float, default=0.05, help="ITL SLA (s)")
+    p.add_argument("--adjustment-interval", type=float, default=30.0)
+    p.add_argument("--prefill-chips", type=int, default=1)
+    p.add_argument("--decode-chips", type=int, default=1)
+    p.add_argument("--max-chip-budget", type=int, default=64)
+    p.add_argument("--min-endpoint", type=int, default=1)
+    p.add_argument("--prefill-component", default="prefill")
+    p.add_argument("--decode-component", default="backend")
+    return p.parse_args(argv)
+
+
+async def run_planner(args: argparse.Namespace) -> None:
+    config = RuntimeConfig.from_settings()
+    if args.store_addr:
+        config.store_addr = args.store_addr
+    if args.namespace:
+        config.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(config)
+
+    with open(args.profile) as f:
+        profile = json.load(f)
+    planner = Planner(
+        PlannerConfig(
+            ttft_sla_s=args.ttft,
+            itl_sla_s=args.itl,
+            adjustment_interval_s=args.adjustment_interval,
+            prefill_engine_num_chips=args.prefill_chips,
+            decode_engine_num_chips=args.decode_chips,
+            min_endpoint=args.min_endpoint,
+            max_chip_budget=args.max_chip_budget,
+        ),
+        PrefillInterpolator.from_profile(profile),
+        DecodeInterpolator.from_profile(profile),
+        VirtualConnector(runtime.store,
+                         namespace=runtime.namespace().name),
+        prefill_component=args.prefill_component,
+        decode_component=args.decode_component,
+    )
+
+    subject = f"{runtime.namespace().name}/frontend_stats"
+    sub = await runtime.store.subscribe(subject)
+
+    async def _ingest():
+        nonlocal sub
+        while True:
+            event = await sub.next()
+            if event is None or event["event"] == "dropped":
+                log.warning("frontend_stats subscription lost — resubscribing")
+                await sub.cancel()
+                sub = await runtime.store.subscribe(subject)
+                continue
+            if event["event"] != "msg":
+                continue
+            try:
+                win = msgpack.unpackb(event["value"])
+                planner.observe(WindowMetrics(
+                    num_requests=win.get("num_requests") or 0,
+                    isl_avg=win.get("isl_avg") or 0,
+                    osl_avg=win.get("osl_avg") or 0,
+                    ttft_avg_s=win.get("ttft_avg_s"),
+                    itl_avg_s=win.get("itl_avg_s"),
+                ))
+            except Exception:
+                log.exception("bad frontend_stats payload")
+
+    ingest_task = asyncio.create_task(_ingest())
+    log.info("planner running (interval=%ss)", args.adjustment_interval)
+    try:
+        while True:
+            await asyncio.sleep(args.adjustment_interval)
+            await planner.make_adjustments()
+    finally:
+        ingest_task.cancel()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run_planner(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Scaling connectors (ref: components/planner/src/dynamo/planner/
+virtual_connector.py:316, kubernetes_connector.py).
+
+``VirtualConnector`` records target replica counts in the store — an
+orchestrator (test harness, launch script, or the k8s operator equivalent)
+watches ``planner/{namespace}/target`` and realises them. This is the same
+decoupling the reference uses to test the planner without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+
+class VirtualConnector:
+    """Store-backed scaling intent; no processes are touched."""
+
+    def __init__(self, store, namespace: str = "dynamo"):
+        self.store = store
+        self.namespace = namespace
+        self.decision_count = 0
+
+    def _key(self, component: str) -> str:
+        return f"planner/{self.namespace}/target/{component}"
+
+    async def scale(self, component: str, replicas: int) -> None:
+        self.decision_count += 1
+        await self.store.put(self._key(component), json.dumps({
+            "replicas": int(replicas),
+            "ts": time.time(),
+            "decision": self.decision_count,
+        }).encode())
+
+    async def read_target(self, component: str) -> Optional[int]:
+        raw = await self.store.get(self._key(component))
+        if raw is None:
+            return None
+        return int(json.loads(raw)["replicas"])
+
+
+class CallbackConnector:
+    """In-process connector for unit tests: records scale() calls."""
+
+    def __init__(self):
+        self.calls: list = []
+        self.targets: Dict[str, int] = {}
+
+    async def scale(self, component: str, replicas: int) -> None:
+        self.calls.append((component, int(replicas)))
+        self.targets[component] = int(replicas)

@@ -1,0 +1,163 @@
+"""Planner core: metrics window → load prediction → replica targets
+(ref: components/planner/src/dynamo/planner/utils/planner_core.py —
+observe_metrics:193, predict_load:240, _compute_replica_requirements:259).
+
+Every adjustment interval the planner:
+1. observes the window's request rate, mean ISL/OSL, and measured TTFT/ITL;
+2. updates correction factors = measured latency / interpolated latency
+   (queueing and interference the offline profile can't see);
+3. predicts next-window load with per-signal predictors;
+4. converts predicted load into prefill/decode replica counts using the
+   profiled perf curves, clamps to the chip budget, and emits the targets
+   through the connector.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.logging import get_logger
+from .interpolation import DecodeInterpolator, PrefillInterpolator
+from .predictors import ARPredictor
+
+log = get_logger("planner")
+
+
+@dataclass
+class WindowMetrics:
+    """One adjustment window's observed aggregates."""
+
+    num_requests: float
+    isl_avg: float
+    osl_avg: float
+    ttft_avg_s: Optional[float] = None
+    itl_avg_s: Optional[float] = None
+
+    @property
+    def is_valid(self) -> bool:
+        vals = [self.num_requests, self.isl_avg, self.osl_avg]
+        return all(v is not None and v == v and v > 0 for v in vals)
+
+
+@dataclass
+class PlannerConfig:
+    ttft_sla_s: float = 0.5
+    itl_sla_s: float = 0.05
+    adjustment_interval_s: float = 60.0
+    prefill_engine_num_chips: int = 1
+    decode_engine_num_chips: int = 1
+    min_endpoint: int = 1
+    max_chip_budget: int = 64
+    predictor_order: int = 4
+
+
+class Planner:
+    def __init__(
+        self,
+        config: PlannerConfig,
+        prefill: PrefillInterpolator,
+        decode: DecodeInterpolator,
+        connector,
+        prefill_component: str = "prefill",
+        decode_component: str = "backend",
+    ):
+        self.config = config
+        self.prefill = prefill
+        self.decode = decode
+        self.connector = connector
+        self.prefill_component = prefill_component
+        self.decode_component = decode_component
+        p = config.predictor_order
+        self._pred_req = ARPredictor(p)
+        self._pred_isl = ARPredictor(p)
+        self._pred_osl = ARPredictor(p)
+        self.p_correction = 1.0
+        self.d_correction = 1.0
+        self.last_targets = (config.min_endpoint, config.min_endpoint)
+
+    # ------------------------- observation -----------------------------
+
+    def observe(self, m: WindowMetrics) -> None:
+        if not m.is_valid:
+            return
+        self._pred_req.observe(m.num_requests)
+        self._pred_isl.observe(m.isl_avg)
+        self._pred_osl.observe(m.osl_avg)
+        if m.ttft_avg_s:
+            expect = self.prefill.interpolate_ttft(m.isl_avg)
+            if expect > 0:
+                self.p_correction = m.ttft_avg_s / expect
+        if m.itl_avg_s:
+            expect = self.decode.interpolate_itl(
+                0.5, m.isl_avg + m.osl_avg / 2
+            )
+            if expect > 0:
+                self.d_correction = m.itl_avg_s / expect
+
+    # ------------------------- planning --------------------------------
+
+    def compute_replicas(self, num_req: float, isl: float,
+                         osl: float) -> tuple:
+        """Replica counts meeting the SLAs at the predicted load
+        (semantics of ref _compute_replica_requirements:259-355)."""
+        cfg = self.config
+        interval = cfg.adjustment_interval_s
+
+        # prefill: queueing delay scales ~linearly with backlog, so spend
+        # replicas proportional to the TTFT overshoot (capped at 1 —
+        # running *better* than SLA must not scale us below the load)
+        prefill_tput = (num_req * isl / interval
+                        * max(1.0, min(self.p_correction, 4.0)))
+        per_prefill = (self.prefill.interpolate_thpt_per_chip(isl)
+                       * cfg.prefill_engine_num_chips)
+        num_p = math.ceil(prefill_tput / max(per_prefill, 1e-9))
+
+        # decode: tighten the ITL target by the observed interference,
+        # then run each chip at the best profiled point meeting it
+        corrected_itl = (cfg.itl_sla_s / self.d_correction
+                         if self.d_correction > 0 else cfg.itl_sla_s)
+        best_tput, _, _ = self.decode.find_best_throughput_per_chip(
+            itl_s=corrected_itl, context_length=isl + osl / 2
+        )
+        decode_tput = num_req * osl / interval
+        num_d = math.ceil(
+            decode_tput / max(best_tput * cfg.decode_engine_num_chips, 1e-9)
+        )
+
+        num_p = max(num_p, cfg.min_endpoint)
+        num_d = max(num_d, cfg.min_endpoint)
+
+        total = (num_p * cfg.prefill_engine_num_chips
+                 + num_d * cfg.decode_engine_num_chips)
+        if total > cfg.max_chip_budget:
+            scale = cfg.max_chip_budget / total
+            num_p = max(cfg.min_endpoint, round(num_p * scale))
+            num_d = max(cfg.min_endpoint, math.floor(
+                (cfg.max_chip_budget
+                 - num_p * cfg.prefill_engine_num_chips)
+                / cfg.decode_engine_num_chips
+            ))
+            log.warning("chip budget clamps targets to p=%d d=%d",
+                        num_p, num_d)
+        return num_p, num_d
+
+    async def make_adjustments(self) -> Optional[tuple]:
+        """Predict next window, emit targets. Returns (num_p, num_d) or
+        None when there is no traffic history yet."""
+        req = self._pred_req.predict()
+        isl = self._pred_isl.predict()
+        osl = self._pred_osl.predict()
+        if not req or not isl or not osl:
+            return None
+        num_p, num_d = self.compute_replicas(req, isl, osl)
+        if (num_p, num_d) != self.last_targets:
+            log.info("scaling targets: prefill=%d decode=%d "
+                     "(req=%.1f isl=%.0f osl=%.0f pcorr=%.2f dcorr=%.2f)",
+                     num_p, num_d, req, isl, osl,
+                     self.p_correction, self.d_correction)
+        await self.connector.scale(self.prefill_component, num_p)
+        await self.connector.scale(self.decode_component, num_d)
+        self.last_targets = (num_p, num_d)
+        return num_p, num_d

@@ -1,0 +1,184 @@
+"""Planner: predictors, interpolation, replica calculation, store connector.
+
+Scenario shapes ported from the reference's
+tests/planner/test_replica_calculation.py (load up → scale up; SLA met →
+hold; budget clamp) against our own profile curves.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ARPredictor, ConstantPredictor, DecodeInterpolator, MovingAveragePredictor,
+    Planner, PlannerConfig, PrefillInterpolator, VirtualConnector,
+    WindowMetrics,
+)
+from dynamo_tpu.planner.connector import CallbackConnector
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _interpolators():
+    # prefill: 10k tok/s/chip flat; ttft grows with isl
+    prefill = PrefillInterpolator(
+        isl=[128, 1024, 8192],
+        ttft_s=[0.05, 0.2, 1.6],
+        thpt_per_chip=[10000, 10000, 10000],
+    )
+    # decode: higher kv usage -> more throughput but worse itl
+    decode = DecodeInterpolator(
+        kv_usage=[0.1, 0.5, 0.9] * 2,
+        context_length=[512] * 3 + [4096] * 3,
+        itl_s=[0.01, 0.03, 0.08, 0.02, 0.05, 0.12],
+        thpt_per_chip=[500, 2000, 4000, 300, 1200, 2500],
+    )
+    return prefill, decode
+
+
+# --------------------------- predictors -----------------------------------
+
+
+def test_constant_predictor():
+    p = ConstantPredictor()
+    assert p.predict() is None
+    p.observe(3.0)
+    p.observe(5.0)
+    assert p.predict() == 5.0
+
+
+def test_moving_average_predictor():
+    p = MovingAveragePredictor(window=2)
+    for v in (2.0, 4.0, 6.0):
+        p.observe(v)
+    assert p.predict() == 5.0
+
+
+def test_ar_predictor_tracks_trend():
+    p = ARPredictor(order=2, history=32)
+    for t in range(20):
+        p.observe(10.0 + 2.0 * t)
+    # one-step-ahead of a linear ramp should continue the ramp
+    assert p.predict() == pytest.approx(50.0, rel=0.1)
+
+
+# ------------------------- interpolation ----------------------------------
+
+
+def test_prefill_interpolation_clamps_and_interpolates():
+    prefill, _ = _interpolators()
+    assert prefill.interpolate_ttft(128) == pytest.approx(0.05)
+    mid = prefill.interpolate_ttft(576)  # halfway 128..1024
+    assert 0.05 < mid < 0.2
+    assert prefill.interpolate_ttft(10**6) == pytest.approx(1.6)
+
+
+def test_decode_inverse_lookup_respects_itl():
+    _, decode = _interpolators()
+    thpt, kv, itl = decode.find_best_throughput_per_chip(
+        itl_s=0.05, context_length=512
+    )
+    assert itl <= 0.051
+    # loosening the SLA can only raise achievable throughput
+    thpt2, _, _ = decode.find_best_throughput_per_chip(
+        itl_s=0.2, context_length=512
+    )
+    assert thpt2 >= thpt
+
+
+# ------------------------ replica calculation ------------------------------
+
+
+def _planner(connector=None, **cfg_kw):
+    prefill, decode = _interpolators()
+    base = dict(ttft_sla_s=0.5, itl_sla_s=0.05, adjustment_interval_s=10.0,
+                max_chip_budget=64)
+    base.update(cfg_kw)
+    cfg = PlannerConfig(**base)
+    return Planner(cfg, prefill, decode, connector or CallbackConnector())
+
+
+def test_replicas_scale_with_load():
+    planner = _planner()
+    low = planner.compute_replicas(num_req=10, isl=1024, osl=128)
+    high = planner.compute_replicas(num_req=100, isl=1024, osl=128)
+    assert high[0] >= low[0] and high[1] >= low[1]
+    assert high[0] > 1  # 100 req * 1024 isl / 10s = 10240 tok/s > 1 chip
+
+
+def test_budget_clamp():
+    planner = _planner(max_chip_budget=4)
+    p, d = planner.compute_replicas(num_req=10000, isl=8192, osl=1024)
+    assert p + d <= 4 + 1  # min_endpoint floors can exceed by design
+    assert p >= 1 and d >= 1
+
+
+def test_correction_factor_raises_prefill():
+    planner = _planner()
+    base_p, _ = planner.compute_replicas(50, 1024, 128)
+    # observe TTFT 3x worse than profiled -> queueing -> more prefill
+    planner.observe(WindowMetrics(
+        num_requests=50, isl_avg=1024, osl_avg=128,
+        ttft_avg_s=3 * 0.2, itl_avg_s=None,
+    ))
+    assert planner.p_correction == pytest.approx(3.0)
+    slow_p, _ = planner.compute_replicas(50, 1024, 128)
+    assert slow_p >= base_p
+
+
+async def test_make_adjustments_via_callback():
+    conn = CallbackConnector()
+    planner = _planner(conn)
+    assert await planner.make_adjustments() is None  # no history yet
+    for _ in range(3):
+        planner.observe(WindowMetrics(
+            num_requests=100, isl_avg=1024, osl_avg=128,
+            ttft_avg_s=0.2, itl_avg_s=0.03,
+        ))
+    out = await planner.make_adjustments()
+    assert out is not None
+    assert conn.targets["prefill"] == out[0]
+    assert conn.targets["backend"] == out[1]
+
+
+async def test_virtual_connector_store_roundtrip():
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    client = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        await _connector_roundtrip(client)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def _connector_roundtrip(store_client):
+    conn = VirtualConnector(store_client, namespace="ns1")
+    await conn.scale("backend", 7)
+    assert await conn.read_target("backend") == 7
+    await conn.scale("backend", 3)
+    assert await conn.read_target("backend") == 3
+
+
+def test_frontend_window_stats_drain():
+    from dynamo_tpu.frontend.service import WindowStats
+
+    ws = WindowStats()
+    assert ws.drain()["isl_avg"] is None
+    ws.num_requests = 2
+    ws.isl_sum = 200
+    ws.osl_sum = 60
+    ws.ttft_sum, ws.ttft_count = 0.4, 2
+    ws.itl_sum, ws.itl_count = 1.0, 50
+    win = ws.drain()
+    assert win["isl_avg"] == 100 and win["osl_avg"] == 30
+    assert win["ttft_avg_s"] == pytest.approx(0.2)
+    assert win["itl_avg_s"] == pytest.approx(0.02)
+    # drained: next window starts clean
+    assert ws.drain()["num_requests"] == 0
