@@ -35,6 +35,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         choices=["round_robin", "random", "kv"],
     )
     p.add_argument(
+        "--busy-threshold", type=float, default=0.0,
+        help="reject with 503 when every worker's KV usage is above this "
+             "fraction (0 disables; ref: push_router.rs busy rejection)",
+    )
+    p.add_argument(
         "--stats-publish-interval", type=float, default=10.0,
         help="seconds between frontend_stats publishes for the planner "
              "(0 disables)",
@@ -56,6 +61,7 @@ async def run_frontend(args: argparse.Namespace) -> None:
     )
     clients = {}
     kv_routers = {}
+    monitors = {}
 
     async def on_add(card: ModelDeploymentCard, entry: dict) -> None:
         endpoint = (
@@ -64,6 +70,15 @@ async def run_frontend(args: argparse.Namespace) -> None:
         )
         client = await endpoint.client()
         clients[card.name] = client
+        if args.busy_threshold > 0:
+            from ..router.monitor import WorkerMonitor
+
+            monitor = WorkerMonitor(
+                client, busy_threshold=args.busy_threshold
+            )
+            await monitor.start()
+            monitor.attach()
+            monitors[card.name] = monitor
         sink = None
         if args.router_mode == "kv":
             sink, kv_routers[card.name] = await make_kv_sink(card, client)
@@ -78,6 +93,9 @@ async def run_frontend(args: argparse.Namespace) -> None:
 
     async def on_remove(name: str) -> None:
         manager.remove(name)
+        monitor = monitors.pop(name, None)
+        if monitor:
+            await monitor.stop()
         router = kv_routers.pop(name, None)
         if router:
             await router.stop()

@@ -23,7 +23,10 @@ from ..utils.metrics import MetricsRegistry
 from .context import Context
 from .engine import AsyncEngine, FnEngine
 from .store import StoreClient
-from .transport import EngineError, ERR_UNAVAILABLE, IngressServer, TransportClient
+from .transport import (
+    EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE, IngressServer,
+    TransportClient,
+)
 
 log = get_logger("component")
 
@@ -244,6 +247,9 @@ class Client:
         self.endpoint = endpoint
         self.runtime = endpoint.runtime
         self.instances: Dict[int, Instance] = {}
+        # optional busy gate (ref: push_router.rs:58-63 busy-threshold
+        # rejection); installed by router.monitor.WorkerMonitor.attach()
+        self.busy_fn: Optional[Callable[[int], bool]] = None
         self._rr = 0
         self._watch_task: Optional[asyncio.Task] = None
         self._instances_changed = asyncio.Event()
@@ -342,6 +348,14 @@ class Client:
             raise EngineError(
                 f"no instances for {self.endpoint.path}", ERR_UNAVAILABLE
             )
+        if self.busy_fn is not None:
+            free = [i for i in ids if not self.busy_fn(i)]
+            if not free:
+                raise EngineError(
+                    f"all {len(ids)} instances of {self.endpoint.path} "
+                    "are busy", ERR_OVERLOADED,
+                )
+            ids = free
         if mode == "random":
             chosen = random.choice(ids)
         else:  # round_robin

@@ -71,6 +71,27 @@ async def serve_engine(
         clear_kv, advertise_host=opts.advertise_host
     )
 
+    # active canary probes through the real generate path
+    # (ref: health_check.rs:44; enabled by DYNTPU_HEALTH_CHECK_ENABLED)
+    if runtime.config.health_check_enabled:
+        from .runtime.health import (
+            HealthCheckConfig, HealthCheckManager, engine_canary,
+        )
+
+        health = HealthCheckManager(
+            HealthCheckConfig(period_s=runtime.config.health_check_period_s)
+        )
+        target = f"{opts.component}/{opts.endpoint}"
+        health.register(target, engine_canary(
+            handler if handler is not None else engine
+        ))
+        health.start()
+        served.health_manager = health
+        if runtime.system_server is not None:
+            runtime.system_server.register_probe(
+                target, lambda: health.status(target)
+            )
+
     if tokenizer is not None:
         card = ModelDeploymentCard(
             name=opts.name,
@@ -104,6 +125,9 @@ async def run_until_shutdown(
         asyncio.ensure_future(_shutdown())
 
     async def _shutdown():
+        health = getattr(served, "health_manager", None)
+        if health is not None:
+            await health.stop()
         await served.drain_and_stop()
         await kv_pub.stop()
         await metrics_pub.stop()

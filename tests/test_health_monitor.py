@@ -1,0 +1,142 @@
+"""Health canary manager + worker busy-threshold gating."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.health import (
+    HealthCheckConfig, HealthCheckManager, engine_canary,
+)
+from dynamo_tpu.runtime.transport import EngineError
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+async def test_canary_flips_unhealthy_then_recovers():
+    fail = {"on": False}
+    unhealthy_events = []
+
+    async def probe():
+        if fail["on"]:
+            raise RuntimeError("boom")
+
+    mgr = HealthCheckManager(
+        HealthCheckConfig(period_s=0.01, timeout_s=1.0, failure_threshold=2),
+        on_unhealthy=unhealthy_events.append,
+    )
+    mgr.register("t", probe)
+    mgr.start()
+    try:
+        await asyncio.sleep(0.05)
+        assert mgr.healthy
+        fail["on"] = True
+        await asyncio.sleep(0.1)
+        assert not mgr.healthy
+        assert unhealthy_events == ["t"]
+        assert mgr.status("t")["consecutive_failures"] >= 2
+        fail["on"] = False
+        await asyncio.sleep(0.05)
+        assert mgr.healthy
+    finally:
+        await mgr.stop()
+
+
+async def test_canary_timeout_counts_as_failure():
+    async def probe():
+        await asyncio.sleep(10)
+
+    mgr = HealthCheckManager(
+        HealthCheckConfig(period_s=0.01, timeout_s=0.02,
+                          failure_threshold=1),
+    )
+    mgr.register("slow", probe)
+    mgr.start()
+    try:
+        await asyncio.sleep(0.2)
+        assert not mgr.healthy
+    finally:
+        await mgr.stop()
+
+
+async def test_engine_canary_drives_generate():
+    class FakeEngine:
+        def __init__(self):
+            self.calls = 0
+
+        async def generate(self, request, context):
+            self.calls += 1
+            yield {"token_ids": [5], "finished": True}
+
+    eng = FakeEngine()
+    await engine_canary(eng)()
+    assert eng.calls == 1
+
+    class DeadEngine:
+        async def generate(self, request, context):
+            return
+            yield  # pragma: no cover
+
+    with pytest.raises(RuntimeError):
+        await engine_canary(DeadEngine())()
+
+
+# ------------------------- busy threshold ---------------------------------
+
+
+class _FakeClient:
+    """Just enough Client surface for the _pick busy gate."""
+
+    def __init__(self, ids):
+        from dynamo_tpu.runtime.component import Client
+
+        self._ids = ids
+        self.busy_fn = None
+        self._rr = 0
+        self.endpoint = type("E", (), {"path": "ns/c/e"})()
+        self._pick = Client._pick.__get__(self)
+        self.instances = {i: f"inst{i}" for i in ids}
+
+    def instance_ids(self):
+        return sorted(self._ids)
+
+
+def test_pick_skips_busy_instances():
+    c = _FakeClient([1, 2, 3])
+    c.busy_fn = lambda i: i != 2
+    for _ in range(4):
+        inst = c._pick("round_robin")
+        assert inst == "inst2"
+
+
+def test_pick_rejects_when_all_busy():
+    c = _FakeClient([1, 2])
+    c.busy_fn = lambda i: True
+    with pytest.raises(EngineError) as ei:
+        c._pick("round_robin")
+    assert ei.value.code == "overloaded"
+
+
+def test_monitor_busy_logic():
+    from dynamo_tpu.router.monitor import WorkerMonitor
+
+    mon = WorkerMonitor.__new__(WorkerMonitor)
+    mon.busy_threshold = 0.9
+    mon.stale_s = 30.0
+    mon.worker_stats = {}
+    mon._recv_at = {}
+    import time
+
+    assert not mon.is_busy(1)           # no stats -> not busy
+    mon.worker_stats[1] = {"kv_usage": 0.95}
+    mon._recv_at[1] = time.monotonic()
+    assert mon.is_busy(1)
+    mon.worker_stats[1] = {"kv_usage": 0.5}
+    assert not mon.is_busy(1)
+    mon.worker_stats[2] = {"kv_usage": 1.0}
+    mon._recv_at[2] = time.monotonic() - 100.0   # stale -> not busy
+    assert not mon.is_busy(2)
