@@ -1,0 +1,141 @@
+"""Task tracker: schedulers, error policies, retries, cascading cancel."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.tasks import (
+    OnError, RetryPolicy, SemaphoreScheduler, TaskTracker,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+async def test_spawn_and_join():
+    tr = TaskTracker()
+    results = []
+
+    async def work(i):
+        results.append(i)
+
+    for i in range(5):
+        tr.spawn(lambda i=i: work(i))
+    await tr.join()
+    assert sorted(results) == list(range(5))
+    assert tr.stats.succeeded == 5 and tr.stats.failed == 0
+
+
+async def test_semaphore_scheduler_limits_concurrency():
+    tr = TaskTracker(scheduler=SemaphoreScheduler(2))
+    running = 0
+    peak = 0
+
+    async def work():
+        nonlocal running, peak
+        running += 1
+        peak = max(peak, running)
+        await asyncio.sleep(0.02)
+        running -= 1
+
+    for _ in range(8):
+        tr.spawn(work)
+    await tr.join()
+    assert peak <= 2
+    assert tr.stats.succeeded == 8
+
+
+async def test_log_policy_counts_failures():
+    tr = TaskTracker(on_error=OnError.LOG)
+    errors = []
+    tr.error_handler = lambda name, e: errors.append((name, str(e)))
+
+    async def bad():
+        raise ValueError("nope")
+
+    t = tr.spawn(bad)
+    await tr.join()
+    assert t.result() is None  # swallowed, not raised
+    assert tr.stats.failed == 1
+    assert errors and "nope" in errors[0][1]
+
+
+async def test_retry_policy_retries_then_succeeds():
+    tr = TaskTracker(
+        on_error=OnError.RETRY,
+        retry=RetryPolicy(max_retries=5, backoff_s=0.001),
+    )
+    attempts = {"n": 0}
+
+    async def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    t = tr.spawn(flaky)
+    await tr.join()
+    assert t.result() == "ok"
+    assert tr.stats.retried == 2 and tr.stats.succeeded == 1
+
+
+async def test_retry_exhaustion_fails():
+    tr = TaskTracker(
+        on_error=OnError.RETRY,
+        retry=RetryPolicy(max_retries=2, backoff_s=0.001),
+    )
+
+    async def always_bad():
+        raise RuntimeError("permanent")
+
+    tr.spawn(always_bad)
+    await tr.join()
+    assert tr.stats.retried == 2 and tr.stats.failed == 1
+
+
+async def test_shutdown_policy_cancels_tracker():
+    tr = TaskTracker(on_error=OnError.SHUTDOWN)
+    cancelled = asyncio.Event()
+
+    async def long_running():
+        try:
+            await asyncio.sleep(30)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    async def bad():
+        await asyncio.sleep(0.01)
+        raise RuntimeError("fatal")
+
+    tr.spawn(long_running)
+    tr.spawn(bad)
+    await tr.join()
+    assert cancelled.is_set()
+    with pytest.raises(RuntimeError):
+        tr.spawn(bad)  # cancelled tracker refuses new work
+
+
+async def test_child_cascade_cancel():
+    root = TaskTracker()
+    child = root.child("sub")
+    child_cancelled = asyncio.Event()
+
+    async def long_running():
+        try:
+            await asyncio.sleep(30)
+        except asyncio.CancelledError:
+            child_cancelled.set()
+            raise
+
+    child.spawn(long_running)
+    await asyncio.sleep(0.01)
+    assert root.active == 1
+    root.cancel()
+    await root.join()
+    assert child_cancelled.is_set()
+    assert root.active == 0
