@@ -89,6 +89,8 @@ async def run_frontend(args: argparse.Namespace) -> None:
             name=card.name, engine=engine,
             chat="chat" in card.model_type,
             completions="completions" in card.model_type,
+            tool_call_parser=card.tool_call_parser,
+            reasoning_parser=card.reasoning_parser,
         ))
 
     async def on_remove(name: str) -> None:

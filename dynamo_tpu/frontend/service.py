@@ -39,6 +39,19 @@ class ModelEntry:
     completions: bool = True
     created: int = field(default_factory=lambda: int(time.time()))
     metadata: dict = field(default_factory=dict)
+    tool_call_parser: Optional[str] = None
+    reasoning_parser: Optional[str] = None
+
+    def make_parser(self):
+        """Fresh per-request stream parser pipeline (or None)."""
+        if not (self.tool_call_parser or self.reasoning_parser):
+            return None
+        from ..llm.parsers import StreamParserPipeline
+
+        return StreamParserPipeline(
+            reasoning=self.reasoning_parser,
+            tool_calls=self.tool_call_parser,
+        )
 
 
 class ModelManager:
@@ -227,7 +240,9 @@ class HttpService:
             outputs = entry.engine.generate(body, ctx)
             outputs = self._observe(outputs, model, t0)
             if kind == "chat":
-                chunks = oai.chat_stream(outputs, rid, model)
+                chunks = oai.chat_stream(
+                    outputs, rid, model, parser=entry.make_parser()
+                )
             else:
                 chunks = oai.completion_stream(outputs, rid, model)
             if stream_mode:

@@ -40,6 +40,10 @@ class ModelDeploymentCard:
     bos_token_id: Optional[int] = None
     model_type: list = field(default_factory=lambda: ["chat", "completions"])
     runtime_config: dict = field(default_factory=dict)  # ModelRuntimeConfig
+    # streaming output parsers (ref: lib/parsers): "hermes"|"json"|"pythonic"
+    tool_call_parser: Optional[str] = None
+    # truthy → split <think>…</think> into reasoning_content
+    reasoning_parser: Optional[str] = None
 
     def to_wire(self) -> dict:
         return {
@@ -54,6 +58,8 @@ class ModelDeploymentCard:
             "bos_token_id": self.bos_token_id,
             "model_type": self.model_type,
             "runtime_config": self.runtime_config,
+            "tool_call_parser": self.tool_call_parser,
+            "reasoning_parser": self.reasoning_parser,
         }
 
     @staticmethod
@@ -70,6 +76,8 @@ class ModelDeploymentCard:
             bos_token_id=d.get("bos_token_id"),
             model_type=list(d.get("model_type", ["chat", "completions"])),
             runtime_config=dict(d.get("runtime_config", {})),
+            tool_call_parser=d.get("tool_call_parser"),
+            reasoning_parser=d.get("reasoning_parser"),
         )
 
     def load_tokenizer(self) -> Tokenizer:

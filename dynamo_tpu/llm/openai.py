@@ -77,12 +77,18 @@ def chat_chunk(
     role: Optional[str] = None,
     finish_reason: Optional[str] = None,
     usage: Optional[dict] = None,
+    reasoning: Optional[str] = None,
+    tool_calls: Optional[list] = None,
 ) -> dict:
     delta: dict = {}
     if role is not None:
         delta["role"] = role
     if content is not None:
         delta["content"] = content
+    if reasoning:
+        delta["reasoning_content"] = reasoning
+    if tool_calls:
+        delta["tool_calls"] = tool_calls
     out = {
         "id": rid,
         "object": "chat.completion.chunk",
@@ -139,26 +145,57 @@ def _map_finish(reason: Optional[str]) -> Optional[str]:
 
 
 async def chat_stream(
-    outputs: AsyncIterator[BackendOutput], rid: str, model: str
+    outputs: AsyncIterator[BackendOutput], rid: str, model: str,
+    parser=None,
 ) -> AsyncIterator[dict]:
-    """Fold BackendOutputs into chat.completion.chunk frames."""
+    """Fold BackendOutputs into chat.completion.chunk frames.
+
+    ``parser`` (llm.parsers.StreamParserPipeline) re-splits decoded text
+    into content / reasoning_content / tool_calls deltas."""
     created = int(time.time())
     yield chat_chunk(rid, model, created, role="assistant", content="")
     prompt_tokens = 0
     cum = 0
     reason = "stop"
+    saw_tool_calls = False
+
+    def _frames(text):
+        nonlocal saw_tool_calls
+        if parser is None:
+            if text:
+                yield chat_chunk(rid, model, created, content=text)
+            return
+        d = parser.push(text)
+        if not d.empty:
+            saw_tool_calls = saw_tool_calls or bool(d.tool_calls)
+            yield chat_chunk(
+                rid, model, created, content=d.content or None,
+                reasoning=d.reasoning, tool_calls=d.tool_calls,
+            )
+
     async for out in outputs:
         prompt_tokens = out.num_prompt_tokens or prompt_tokens
         cum = out.cum_tokens or cum
         if out.finish_reason is not None:
             reason = out.finish_reason
-            if out.text:
-                yield chat_chunk(rid, model, created, content=out.text)
+            for f in _frames(out.text or ""):
+                yield f
             break
-        if out.text:
-            yield chat_chunk(rid, model, created, content=out.text)
+        for f in _frames(out.text or ""):
+            yield f
+    if parser is not None:
+        d = parser.flush()
+        if not d.empty:
+            saw_tool_calls = saw_tool_calls or bool(d.tool_calls)
+            yield chat_chunk(
+                rid, model, created, content=d.content or None,
+                reasoning=d.reasoning, tool_calls=d.tool_calls,
+            )
+    finish = _map_finish(reason) or "stop"
+    if saw_tool_calls and finish == "stop":
+        finish = "tool_calls"
     yield chat_chunk(
-        rid, model, created, finish_reason=_map_finish(reason) or "stop",
+        rid, model, created, finish_reason=finish,
         usage=usage_dict(prompt_tokens, cum),
     )
 
@@ -195,6 +232,8 @@ async def aggregate_chat(chunks: AsyncIterator[dict]) -> dict:
     rid = model = ""
     created = 0
     text_parts: List[str] = []
+    reasoning_parts: List[str] = []
+    tool_calls: List[dict] = []
     role = "assistant"
     finish = "stop"
     usage = None
@@ -206,19 +245,26 @@ async def aggregate_chat(chunks: AsyncIterator[dict]) -> dict:
             role = delta["role"]
         if delta.get("content"):
             text_parts.append(delta["content"])
+        if delta.get("reasoning_content"):
+            reasoning_parts.append(delta["reasoning_content"])
+        if delta.get("tool_calls"):
+            tool_calls.extend(delta["tool_calls"])
         if choice.get("finish_reason"):
             finish = choice["finish_reason"]
         if c.get("usage"):
             usage = c["usage"]
+    message: dict = {"role": role, "content": "".join(text_parts)}
+    if reasoning_parts:
+        message["reasoning_content"] = "".join(reasoning_parts)
+    if tool_calls:
+        message["tool_calls"] = tool_calls
     return {
         "id": rid,
         "object": "chat.completion",
         "created": created,
         "model": model,
         "choices": [
-            {"index": 0,
-             "message": {"role": role, "content": "".join(text_parts)},
-             "finish_reason": finish}
+            {"index": 0, "message": message, "finish_reason": finish}
         ],
         "usage": usage or usage_dict(0, 0),
     }

@@ -28,6 +28,8 @@ class ServeOptions:
     endpoint: str = "generate"
     advertise_host: str = "127.0.0.1"
     migration_limit: int = 3
+    tool_call_parser: Optional[str] = None
+    reasoning_parser: Optional[str] = None
 
 
 async def serve_engine(
@@ -107,6 +109,8 @@ async def serve_engine(
                 "max_num_seqs": eng_cfg.max_num_seqs,
                 "max_num_batched_tokens": eng_cfg.max_num_batched_tokens,
             },
+            tool_call_parser=opts.tool_call_parser,
+            reasoning_parser=opts.reasoning_parser,
         )
         await register_llm(endpoint, card)
 

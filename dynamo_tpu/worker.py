@@ -40,6 +40,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="served model name (default: preset name)")
     p.add_argument("--tokenizer", default=None,
                    help="tokenizer.json path or HF model dir")
+    p.add_argument("--tool-call-parser", default=None,
+                   choices=["hermes", "json", "pythonic"],
+                   help="streaming tool-call parser advertised in the MDC")
+    p.add_argument("--reasoning-parser", default=None,
+                   help="set to split <think>…</think> into "
+                        "reasoning_content (e.g. 'think')")
     p.add_argument("--weights", default=None,
                    help="HF checkpoint dir (*.safetensors [+ config.json, "
                         "which overrides --model]; tokenizer defaults to "
@@ -143,6 +149,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         name=name, component=component, endpoint=args.endpoint,
         advertise_host=args.advertise_host,
         migration_limit=args.migration_limit,
+        tool_call_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser,
     )
     served, kv_pub, metrics_pub = await serve_engine(
         runtime, engine, eng_cfg, opts, tokenizer, handler=handler

@@ -1,0 +1,179 @@
+"""Streaming reasoning + tool-call parsers (ref: lib/parsers test shapes)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.parsers import (
+    HermesToolParser, JsonToolParser, PythonicToolParser, ReasoningParser,
+    StreamParserPipeline,
+)
+
+
+def _drain(parser, pieces):
+    """Push text in chunks, collect merged deltas incl. flush."""
+    content = reasoning = ""
+    calls = []
+    for p in pieces:
+        d = parser.push(p)
+        content += d.content
+        reasoning += d.reasoning
+        calls.extend(d.tool_calls)
+    d = parser.flush()
+    content += d.content
+    reasoning += d.reasoning
+    calls.extend(d.tool_calls)
+    return content, reasoning, calls
+
+
+# ----------------------------- reasoning -----------------------------------
+
+
+def test_reasoning_basic_split():
+    c, r, _ = _drain(ReasoningParser(),
+                     ["<think>step 1</think>the answer"])
+    assert r == "step 1"
+    assert c == "the answer"
+
+
+def test_reasoning_tag_split_across_chunks():
+    c, r, _ = _drain(ReasoningParser(),
+                     ["<th", "ink>rea", "soning</th", "ink>out"])
+    assert r == "reasoning"
+    assert c == "out"
+
+
+def test_reasoning_unterminated_kept_as_reasoning():
+    c, r, _ = _drain(ReasoningParser(), ["<think>never closed"])
+    assert r == "never closed"
+    assert c == ""
+
+
+def test_reasoning_no_tags_passthrough_streaming():
+    p = ReasoningParser()
+    d = p.push("hello world")
+    # everything except a potential tag prefix must flow immediately
+    assert d.content == "hello world"
+
+
+# ------------------------------ hermes -------------------------------------
+
+
+def test_hermes_tool_call():
+    c, _, calls = _drain(HermesToolParser(), [
+        'check: <tool_call>{"name": "get_weather", '
+        '"arguments": {"city": "SF"}}</tool_call> done',
+    ])
+    assert c == "check:  done"
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+
+
+def test_hermes_split_tag_jails_until_complete():
+    p = HermesToolParser()
+    d1 = p.push("x <tool_")
+    assert d1.content == "x "          # partial tag held back
+    d2 = p.push('call>{"name": "f", "arguments": {}}</tool_')
+    assert d2.content == "" and not d2.tool_calls
+    d3 = p.push("call>")
+    assert len(d3.tool_calls) == 1
+
+
+# ------------------------------- json --------------------------------------
+
+
+def test_json_tool_call_llama_style():
+    c, _, calls = _drain(JsonToolParser(), [
+        '{"name": "search", "parameters": {"q": "tpu"}}',
+    ])
+    assert c == ""
+    assert calls[0]["function"]["name"] == "search"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"q": "tpu"}
+
+
+def test_json_plain_text_passes_through():
+    c, _, calls = _drain(JsonToolParser(), ["just a normal answer"])
+    assert c == "just a normal answer"
+    assert not calls
+
+
+def test_json_nested_braces_and_strings():
+    raw = ('{"name": "f", "arguments": {"code": "if x { y }", '
+           '"s": "a\\"b"}}')
+    c, _, calls = _drain(JsonToolParser(), [raw[:10], raw[10:]])
+    assert calls and json.loads(calls[0]["function"]["arguments"])[
+        "code"] == "if x { y }"
+
+
+# ------------------------------ pythonic -----------------------------------
+
+
+def test_pythonic_tool_calls():
+    c, _, calls = _drain(PythonicToolParser(), [
+        '[get_weather(city="SF"), add(a=1, b=2)]',
+    ])
+    assert c == ""
+    assert [t["function"]["name"] for t in calls] == ["get_weather", "add"]
+    assert json.loads(calls[1]["function"]["arguments"]) == {"a": 1, "b": 2}
+
+
+def test_pythonic_regular_list_prose_flushes_as_content():
+    c, _, calls = _drain(PythonicToolParser(), ["items: [1, 2, 3] ok"])
+    assert not calls
+    assert "items:" in c and "ok" in c
+
+
+# ------------------------------ pipeline -----------------------------------
+
+
+def test_pipeline_reasoning_then_tool_call():
+    pipe = StreamParserPipeline(reasoning="think", tool_calls="hermes")
+    pieces = [
+        "<think>I should call the tool</think>",
+        'sure. <tool_call>{"name": "f", "arguments": {"x": 1}}</tool_call>',
+    ]
+    content = reasoning = ""
+    calls = []
+    for p in pieces:
+        d = pipe.push(p)
+        content += d.content
+        reasoning += d.reasoning
+        calls.extend(d.tool_calls)
+    d = pipe.flush()
+    content += d.content
+    calls.extend(d.tool_calls)
+    assert reasoning == "I should call the tool"
+    assert content == "sure. "
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "f"
+
+
+@pytest.mark.anyio
+async def test_chat_stream_emits_tool_calls_finish():
+    from dynamo_tpu.llm import openai as oai
+    from dynamo_tpu.llm.protocols import BackendOutput
+
+    async def outputs():
+        yield BackendOutput(
+            token_ids=[1],
+            text='<tool_call>{"name": "f", "arguments": {}}</tool_call>',
+            num_prompt_tokens=3, cum_tokens=5,
+        )
+        yield BackendOutput(token_ids=[], text="", finish_reason="stop",
+                            cum_tokens=5)
+
+    pipe = StreamParserPipeline(tool_calls="hermes")
+    chunks = [c async for c in oai.chat_stream(
+        outputs(), "id1", "m", parser=pipe
+    )]
+    finals = [c for c in chunks
+              if c["choices"][0].get("finish_reason")]
+    assert finals[-1]["choices"][0]["finish_reason"] == "tool_calls"
+    all_calls = [tc for c in chunks
+                 for tc in c["choices"][0]["delta"].get("tool_calls", [])]
+    assert len(all_calls) == 1
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
