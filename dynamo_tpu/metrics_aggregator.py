@@ -1,0 +1,155 @@
+"""Standalone metrics aggregator component
+(ref: components/metrics/src/main.rs:36 — scrapes worker load metrics,
+subscribes KV events, exposes Prometheus).
+
+    python -m dynamo_tpu.metrics_aggregator --component backend --port 9090
+
+Subscribes to a component's ``load_metrics`` and ``kv_events`` subjects and
+re-exposes per-worker ForwardPassMetrics as Prometheus gauges plus KV-event
+counters (incl. an aggregate prefix-cache hit rate) on a system server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+from typing import Dict
+
+import msgpack
+
+from .router.kv_router import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
+from .runtime.component import DistributedRuntime
+from .runtime.system_server import SystemServer
+from .utils.config import RuntimeConfig
+from .utils.logging import get_logger
+
+log = get_logger("metrics_aggregator")
+
+
+class MetricsAggregator:
+    def __init__(self, runtime: DistributedRuntime, component: str):
+        self.runtime = runtime
+        self.component = runtime.namespace().component(component)
+        m = runtime.metrics.child(component=component)
+        self._g_usage = m.gauge(
+            "worker_kv_usage", "per-worker KV usage", ["worker"]
+        )
+        self._g_running = m.gauge(
+            "worker_requests_running", "running requests", ["worker"]
+        )
+        self._g_waiting = m.gauge(
+            "worker_requests_waiting", "waiting requests", ["worker"]
+        )
+        self._g_hit_rate = m.gauge(
+            "prefix_cache_hit_rate", "aggregate prefix cache hit rate"
+        )
+        self._c_events = m.counter(
+            "kv_events_total", "KV events seen", ["kind"]
+        )
+        self.worker_stats: Dict[int, dict] = {}
+        self._tasks = []
+
+    async def start(self) -> None:
+        store = self.runtime.store
+        for subject, handler in (
+            (self.component.event_subject(LOAD_METRICS_SUBJECT),
+             self._on_stats),
+            (self.component.event_subject(KV_EVENTS_SUBJECT),
+             self._on_kv_event),
+        ):
+            stream = await store.subscribe(subject)
+            self._tasks.append(asyncio.create_task(
+                self._pump(subject, stream, handler)
+            ))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+
+    async def _pump(self, subject: str, stream, handler) -> None:
+        while True:
+            event = await stream.next()
+            if event is None or event["event"] == "dropped":
+                log.warning("subscription %s lost — resubscribing", subject)
+                await stream.cancel()
+                while True:
+                    try:
+                        stream = await self.runtime.store.subscribe(subject)
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.5)
+                continue
+            if event["event"] != "msg":
+                continue
+            try:
+                handler(msgpack.unpackb(event["value"], raw=False))
+            except Exception:
+                log.exception("bad payload on %s", subject)
+
+    def _on_stats(self, snap: dict) -> None:
+        wid = str(snap.get("worker_id", "?"))
+        self.worker_stats[wid] = snap
+        self._g_usage.labels(worker=wid).set(snap.get("kv_usage", 0.0))
+        self._g_running.labels(worker=wid).set(
+            snap.get("num_requests_running", 0))
+        self._g_waiting.labels(worker=wid).set(
+            snap.get("num_requests_waiting", 0))
+        hits = sum(s.get("prefix_cache_hits", 0)
+                   for s in self.worker_stats.values())
+        queries = sum(s.get("prefix_cache_queries", 0)
+                      for s in self.worker_stats.values())
+        self._g_hit_rate.set(hits / queries if queries else 0.0)
+
+    def _on_kv_event(self, payload: dict) -> None:
+        kind = payload.get("event", {}).get("kind", "unknown")
+        self._c_events.labels(kind=kind).inc()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--component", default="backend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9090)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    config = RuntimeConfig.from_settings()
+    if args.store_addr:
+        config.store_addr = args.store_addr
+    if args.namespace:
+        config.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(config)
+
+    agg = MetricsAggregator(runtime, args.component)
+    await agg.start()
+    server = SystemServer(metrics=runtime.metrics, host=args.host,
+                          port=args.port)
+    await server.start()
+
+    loop = asyncio.get_running_loop()
+
+    async def _shutdown():
+        await agg.stop()
+        await server.stop()
+        await runtime.shutdown()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(
+            sig, lambda: asyncio.ensure_future(_shutdown())
+        )
+    log.info("metrics aggregator on %s:%d (component=%s)",
+             args.host, server.port, args.component)
+    await runtime.shutdown_event.wait()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Perf recorder + metrics aggregator."""
+
+import asyncio
+import json
+
+import msgpack
+import pytest
+
+from dynamo_tpu.llm.recorder import Recorder, load_jsonl
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+async def test_recorder_stream_metrics(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    rec = Recorder(path=path)
+
+    async def stream():
+        for i in range(5):
+            await asyncio.sleep(0.005)
+            yield {"token": i}
+
+    items = [x async for x in rec.record_stream("r1", stream())]
+    assert len(items) == 5
+    r = rec.records["r1"]
+    assert r.finished and r.num_items == 5
+    assert r.ttft_s is not None and r.ttft_s >= 0.004
+    assert len(r.itl_s) == 4 and all(x > 0 for x in r.itl_s)
+    summary = r.summary()
+    assert summary["items_per_s"] > 0
+
+    rows = load_jsonl(path)
+    assert rows[0]["request_id"] == "r1"
+    assert rows[0]["summary"]["num_items"] == 5
+
+
+async def test_recorder_error_marked(tmp_path):
+    rec = Recorder()
+
+    async def bad_stream():
+        yield 1
+        raise RuntimeError("dead")
+
+    with pytest.raises(RuntimeError):
+        async for _ in rec.record_stream("r2", bad_stream()):
+            pass
+    r = rec.records["r2"]
+    assert not r.finished
+    assert any(kind == "error" for _, kind, _ in r.events)
+
+
+async def test_recorder_aggregate():
+    rec = Recorder()
+
+    async def stream(n):
+        for i in range(n):
+            await asyncio.sleep(0.001)
+            yield i
+
+    for rid, n in (("a", 3), ("b", 5)):
+        async for _ in rec.record_stream(rid, stream(n)):
+            pass
+    agg = rec.aggregate()
+    assert agg["num_streams"] == 2
+    assert agg["total_items"] == 8
+    assert agg["ttft_p50_s"] > 0
+
+
+async def test_metrics_aggregator_ingests_stats():
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        agg = MetricsAggregator(runtime, "backend")
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        await runtime.store.publish(subject + "7", msgpack.packb({
+            "worker_id": 7, "kv_usage": 0.4, "num_requests_running": 3,
+            "num_requests_waiting": 1, "prefix_cache_hits": 30,
+            "prefix_cache_queries": 60,
+        }))
+        for _ in range(100):
+            if "7" in agg.worker_stats:
+                break
+            await asyncio.sleep(0.01)
+        assert agg.worker_stats["7"]["kv_usage"] == 0.4
+        body = runtime.metrics.render().decode()
+        assert "worker_kv_usage" in body
+        assert 'prefix_cache_hit_rate{component="backend"} 0.5' in body
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
