@@ -35,6 +35,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         choices=["round_robin", "random", "kv"],
     )
     p.add_argument(
+        "--grpc-port", type=int, default=0,
+        help="also serve the KServe v2 gRPC protocol on this port "
+             "(0 disables; ref: grpc/service/kserve.rs)",
+    )
+    p.add_argument(
         "--busy-threshold", type=float, default=0.0,
         help="reject with 503 when every worker's KV usage is above this "
              "fraction (0 disables; ref: push_router.rs busy rejection)",
@@ -109,6 +114,15 @@ async def run_frontend(args: argparse.Namespace) -> None:
     await watcher.start()
     await service.start()
 
+    grpc_service = None
+    if args.grpc_port:
+        from ..kserve import KserveGrpcService
+
+        grpc_service = KserveGrpcService(
+            manager, host=args.host, port=args.grpc_port
+        )
+        await grpc_service.start()
+
     stats_task = None
     if args.stats_publish_interval > 0:
         import msgpack
@@ -139,6 +153,8 @@ async def run_frontend(args: argparse.Namespace) -> None:
         if stats_task is not None:
             stats_task.cancel()
         await watcher.stop()
+        if grpc_service is not None:
+            await grpc_service.stop()
         await service.stop()
         await runtime.shutdown()
 

@@ -1,0 +1,11 @@
+"""KServe v2 gRPC inference frontend
+(ref: lib/llm/src/grpc/service/kserve.rs — the tonic GrpcInferenceService).
+
+``kserve_pb2.py`` is generated from ``kserve.proto`` (checked in; regenerate
+with ``protoc --python_out=. -I . kserve.proto``). The service is registered
+via grpc generic handlers, so no grpc_tools codegen is needed at runtime.
+"""
+
+from .service import KserveGrpcService
+
+__all__ = ["KserveGrpcService"]

@@ -1,0 +1,117 @@
+"""KServe v2 gRPC frontend against a fake engine."""
+
+import grpc
+import pytest
+
+from dynamo_tpu.frontend.service import ModelEntry, ModelManager
+from dynamo_tpu.kserve import KserveGrpcService
+from dynamo_tpu.kserve import kserve_pb2 as pb
+from dynamo_tpu.kserve.service import make_stub
+from dynamo_tpu.llm.protocols import BackendOutput
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+class EchoEngine:
+    """Streams the prompt back word by word."""
+
+    async def generate(self, body, context):
+        words = body.get("prompt", "").split()
+        for i, w in enumerate(words):
+            last = i == len(words) - 1
+            yield BackendOutput(
+                token_ids=[i], text=w + ("" if last else " "),
+                finish_reason="stop" if last else None,
+                cum_tokens=i + 1, num_prompt_tokens=len(words),
+            )
+
+
+@pytest.fixture
+async def service():
+    manager = ModelManager()
+    manager.register(ModelEntry(name="echo", engine=EchoEngine()))
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    await svc.start()
+    yield svc
+    await svc.stop()
+
+
+def _infer_request(model: str, text: str, **params) -> pb.ModelInferRequest:
+    req = pb.ModelInferRequest(model_name=model, id="req-1")
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.append(1)
+    t.contents.bytes_contents.append(text.encode())
+    for k, v in params.items():
+        if isinstance(v, bool):
+            req.parameters[k].bool_param = v
+        elif isinstance(v, int):
+            req.parameters[k].int64_param = v
+        elif isinstance(v, float):
+            req.parameters[k].double_param = v
+        else:
+            req.parameters[k].string_param = str(v)
+    return req
+
+
+async def test_live_ready_metadata(service):
+    async with grpc.aio.insecure_channel(
+        f"127.0.0.1:{service.port}"
+    ) as chan:
+        stub = make_stub(chan)
+        assert (await stub.ServerLive(pb.ServerLiveRequest())).live
+        assert (await stub.ServerReady(pb.ServerReadyRequest())).ready
+        assert (await stub.ModelReady(
+            pb.ModelReadyRequest(name="echo"))).ready
+        assert not (await stub.ModelReady(
+            pb.ModelReadyRequest(name="nope"))).ready
+        meta = await stub.ModelMetadata(pb.ModelMetadataRequest(name="echo"))
+        assert meta.name == "echo"
+        assert meta.inputs[0].name == "text_input"
+
+
+async def test_unary_infer_aggregates(service):
+    async with grpc.aio.insecure_channel(
+        f"127.0.0.1:{service.port}"
+    ) as chan:
+        stub = make_stub(chan)
+        resp = await stub.ModelInfer(
+            _infer_request("echo", "hello tpu world", max_tokens=16)
+        )
+        text = resp.outputs[0].contents.bytes_contents[0].decode()
+        assert text == "hello tpu world"
+        assert resp.parameters["finish_reason"].string_param == "stop"
+
+
+async def test_stream_infer_streams_steps(service):
+    async with grpc.aio.insecure_channel(
+        f"127.0.0.1:{service.port}"
+    ) as chan:
+        stub = make_stub(chan)
+        call = stub.ModelStreamInfer()
+        await call.write(_infer_request("echo", "a b c"))
+        await call.done_writing()
+        texts = []
+        async for resp in call:
+            assert not resp.error_message
+            texts.append(
+                resp.infer_response.outputs[0]
+                .contents.bytes_contents[0].decode()
+            )
+        assert "".join(texts) == "a b c"
+        assert len(texts) == 3
+
+
+async def test_unknown_model_errors(service):
+    async with grpc.aio.insecure_channel(
+        f"127.0.0.1:{service.port}"
+    ) as chan:
+        stub = make_stub(chan)
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await stub.ModelInfer(_infer_request("nope", "x"))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
