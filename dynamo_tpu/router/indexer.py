@@ -68,11 +68,22 @@ class KvIndexer:
     (indexer.rs:224,738); flat because our hashes chain (module docstring).
     """
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int, use_native: Optional[bool] = None):
         self.block_size = block_size
         self._workers_of: Dict[SequenceHash, Set[WorkerId]] = {}
         self._hashes_of: Dict[WorkerId, Set[SequenceHash]] = {}
         self.events_applied = 0
+        # C++ matcher for the per-decision hot loop (native/src); the Python
+        # maps stay authoritative for dump_events/introspection
+        self._native = None
+        if use_native is not False:
+            try:
+                from ..native import NativePrefixIndex, available
+
+                if available():
+                    self._native = NativePrefixIndex()
+            except Exception:
+                self._native = None
 
     # -- event application (ref: indexer.rs:320 apply_event) --
 
@@ -81,12 +92,18 @@ class KvIndexer:
         w = event.worker_id
         if event.kind == "stored":
             held = self._hashes_of.setdefault(w, set())
+            fresh = []
             for b in event.blocks:
                 h = int(b["seq_hash"]) if isinstance(b, dict) else int(b)
+                if h not in held:
+                    fresh.append(h)
                 self._workers_of.setdefault(h, set()).add(w)
                 held.add(h)
+            if self._native is not None and fresh:
+                self._native.stored(w, fresh)
         elif event.kind == "removed":
             held = self._hashes_of.get(w)
+            gone = []
             for h in event.blocks:
                 h = int(h["seq_hash"]) if isinstance(h, dict) else int(h)
                 ws = self._workers_of.get(h)
@@ -94,8 +111,11 @@ class KvIndexer:
                     ws.discard(w)
                     if not ws:
                         del self._workers_of[h]
-                if held is not None:
+                if held is not None and h in held:
                     held.discard(h)
+                    gone.append(h)
+            if self._native is not None and gone:
+                self._native.removed(w, gone)
         elif event.kind == "cleared":
             self.clear_worker(w)
 
@@ -111,6 +131,8 @@ class KvIndexer:
                 ws.discard(worker)
                 if not ws:
                     del self._workers_of[h]
+        if self._native is not None:
+            self._native.clear_worker(worker)
 
     # -- matching (ref: indexer.rs:276 find_matches) --
 
@@ -121,6 +143,10 @@ class KvIndexer:
         blocks before it — with chained hashes that is exactly the radix-walk
         the reference does.
         """
+        if self._native is not None:
+            return OverlapScores(
+                scores=self._native.find_matches(list(seq_hashes))
+            )
         scores: Dict[WorkerId, int] = {}
         for i, h in enumerate(seq_hashes):
             ws = self._workers_of.get(h)

@@ -67,14 +67,39 @@ def compute_block_hashes_for_seq(
     The router-side hot path (same role as the reference's
     ``compute_block_hash_for_seq``, indexer.rs:125, but chained — see module
     docstring): only full blocks participate in prefix matching; the ragged
-    tail is ignored.
+    tail is ignored. Uses the native C++ path when built (native/src),
+    byte-exact with the Python fallback (tests/test_native.py).
     """
+    if len(tokens) >= block_size:
+        native = _native_mod()
+        if native is not None:
+            res = native.block_hashes(tokens, block_size, HASH_SEED)
+            if res is not None:
+                return [int(h) for h in res[1]]
     out: list[SequenceHash] = []
     parent: Optional[SequenceHash] = None
     for start in range(0, len(tokens) - block_size + 1, block_size):
         parent = compute_sequence_hash(parent, tokens[start : start + block_size])
         out.append(parent)
     return out
+
+
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native_mod():
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from . import native as _native
+
+            if _native.available():
+                _NATIVE = _native
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,165 @@
+// Native hot loops for dynamo-tpu: xxh3 block hashing + radix prefix index.
+//
+// Role-equivalent to the reference's native crates (ref: lib/tokens/src/
+// lib.rs — xxh3 token/block hashing; lib/llm/src/kv_router/indexer.rs:224 —
+// the RadixTree the router keeps on a dedicated thread). These are the
+// per-request host-side hot loops: hashing is O(prompt) on every admission,
+// and prefix matching runs per routing decision over fleets of workers.
+//
+// C ABI only (loaded via ctypes); hashes use the vendored public xxhash
+// (XXH3, same family as the reference's xxh3 crate), seed and byte layout
+// matching dynamo_tpu/tokens.py exactly.
+
+#define XXH_INLINE_ALL
+#include "arrow/vendored/xxhash/xxhash.h"
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// --------------------------- block hashing ------------------------------
+//
+// tokens: n u32 token ids. For each complete block of block_size tokens:
+//   block_hash[i] = XXH3_64(le_bytes(block_tokens), seed)
+//   seq_hash[i]   = i == 0 ? block_hash[0]
+//                          : XXH3_64(le_u64(seq_hash[i-1]) || le_bytes, seed)
+// Returns the number of complete blocks written.
+int64_t dyn_block_hashes(const uint32_t* tokens, int64_t n_tokens,
+                         int64_t block_size, uint64_t seed,
+                         uint64_t* block_hashes, uint64_t* seq_hashes) {
+  if (block_size <= 0) return 0;
+  const int64_t n_blocks = n_tokens / block_size;
+  std::vector<uint8_t> buf(8 + static_cast<size_t>(block_size) * 4);
+  uint64_t parent = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    const uint32_t* blk = tokens + b * block_size;
+    // token bytes are u32 LE; x86/TPU hosts are little-endian, memcpy is the
+    // layout-exact fast path
+    uint8_t* body = buf.data() + 8;
+    std::memcpy(body, blk, static_cast<size_t>(block_size) * 4);
+    block_hashes[b] =
+        XXH3_64bits_withSeed(body, static_cast<size_t>(block_size) * 4, seed);
+    if (b == 0) {
+      seq_hashes[b] = block_hashes[b];
+    } else {
+      std::memcpy(buf.data(), &parent, 8);
+      seq_hashes[b] = XXH3_64bits_withSeed(
+          buf.data(), 8 + static_cast<size_t>(block_size) * 4, seed);
+    }
+    parent = seq_hashes[b];
+  }
+  return n_blocks;
+}
+
+// --------------------------- prefix index -------------------------------
+//
+// Maps sequence hash -> set of workers holding that block. Because sequence
+// hashes chain over the whole prefix, longest-prefix matching is a flat walk
+// (no tree pointers needed): a worker matching block i can only match block
+// i+1 if it matched i.
+
+struct PrefixIndex {
+  // seq_hash -> workers (small vectors: a block is usually on few workers)
+  std::unordered_map<uint64_t, std::vector<uint64_t>> blocks;
+  // worker -> refcount per hash (handles duplicate stored events)
+  std::unordered_map<uint64_t, std::unordered_map<uint64_t, int64_t>> owned;
+};
+
+static void index_remove_one(PrefixIndex* ix, uint64_t worker, uint64_t h) {
+  auto it = ix->blocks.find(h);
+  if (it == ix->blocks.end()) return;
+  auto& v = it->second;
+  for (size_t i = 0; i < v.size(); ++i) {
+    if (v[i] == worker) {
+      v[i] = v.back();
+      v.pop_back();
+      break;
+    }
+  }
+  if (v.empty()) ix->blocks.erase(it);
+}
+
+void* dyn_index_new() { return new PrefixIndex(); }
+
+void dyn_index_free(void* handle) {
+  delete static_cast<PrefixIndex*>(handle);
+}
+
+void dyn_index_stored(void* handle, uint64_t worker,
+                      const uint64_t* hashes, int64_t n) {
+  auto* ix = static_cast<PrefixIndex*>(handle);
+  auto& mine = ix->owned[worker];
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t h = hashes[i];
+    if (++mine[h] == 1) ix->blocks[h].push_back(worker);
+  }
+}
+
+void dyn_index_removed(void* handle, uint64_t worker,
+                       const uint64_t* hashes, int64_t n) {
+  auto* ix = static_cast<PrefixIndex*>(handle);
+  auto wit = ix->owned.find(worker);
+  if (wit == ix->owned.end()) return;
+  auto& mine = wit->second;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t h = hashes[i];
+    auto hit = mine.find(h);
+    if (hit == mine.end()) continue;
+    if (--hit->second <= 0) {
+      mine.erase(hit);
+      index_remove_one(ix, worker, h);
+    }
+  }
+}
+
+void dyn_index_clear_worker(void* handle, uint64_t worker) {
+  auto* ix = static_cast<PrefixIndex*>(handle);
+  auto wit = ix->owned.find(worker);
+  if (wit == ix->owned.end()) return;
+  for (const auto& kv : wit->second) index_remove_one(ix, worker, kv.first);
+  ix->owned.erase(wit);
+}
+
+int64_t dyn_index_num_blocks(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<PrefixIndex*>(handle)->blocks.size());
+}
+
+// Longest-prefix match: walks the chained hashes in order; workers_out /
+// depths_out sized max_out. Returns the number of matching workers.
+int64_t dyn_index_find_matches(void* handle, const uint64_t* hashes,
+                               int64_t n, uint64_t* workers_out,
+                               int64_t* depths_out, int64_t max_out) {
+  auto* ix = static_cast<PrefixIndex*>(handle);
+  std::unordered_map<uint64_t, int64_t> depth;  // worker -> matched blocks
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = ix->blocks.find(hashes[i]);
+    bool advanced = false;
+    if (it != ix->blocks.end()) {
+      for (uint64_t w : it->second) {
+        auto dit = depth.find(w);
+        if (i == 0 && dit == depth.end()) {
+          depth[w] = 1;
+          advanced = true;
+        } else if (dit != depth.end() && dit->second == i) {
+          dit->second = i + 1;
+          advanced = true;
+        }
+      }
+    }
+    if (!advanced) break;  // prefix property: nobody can match deeper
+  }
+  int64_t out = 0;
+  for (const auto& kv : depth) {
+    if (out >= max_out) break;
+    workers_out[out] = kv.first;
+    depths_out[out] = kv.second;
+    ++out;
+  }
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,76 @@
+"""Native C++ hashing + prefix index: parity vs the Python implementations."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import tokens as T
+from dynamo_tpu.native import NativePrefixIndex, available, block_hashes
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable"
+)
+
+
+def test_block_hashes_match_python():
+    toks = list(range(1, 100))
+    bs = 8
+    bh, sh = block_hashes(toks, bs, T.HASH_SEED)
+    want_seq = T.compute_block_hashes_for_seq(toks, bs)
+    assert [int(x) for x in sh] == want_seq
+    want_block = [
+        T.compute_block_hash(toks[i * bs:(i + 1) * bs])
+        for i in range(len(toks) // bs)
+    ]
+    assert [int(x) for x in bh] == want_block
+
+
+def test_block_hashes_partial_tail_ignored():
+    bh, sh = block_hashes([1, 2, 3], 4, T.HASH_SEED)
+    assert len(bh) == 0 and len(sh) == 0
+
+
+def test_prefix_index_longest_match():
+    ix = NativePrefixIndex()
+    # worker 1 holds blocks [a,b,c]; worker 2 holds [a,b]; worker 3 holds [x]
+    a, b, c, x = 11, 22, 33, 99
+    ix.stored(1, [a, b, c])
+    ix.stored(2, [a, b])
+    ix.stored(3, [x])
+    assert ix.num_blocks == 4
+
+    m = ix.find_matches([a, b, c])
+    assert m == {1: 3, 2: 2}
+    m = ix.find_matches([a])
+    assert m == {1: 1, 2: 1}
+    assert ix.find_matches([x]) == {3: 1}
+    # chained hashes carry their prefix implicitly: a root lookup of c
+    # matches the worker holding that exact chained hash
+    assert ix.find_matches([c]) == {1: 1}
+
+
+def test_prefix_index_remove_and_clear():
+    ix = NativePrefixIndex()
+    ix.stored(1, [5, 6])
+    ix.stored(2, [5])
+    ix.removed(1, [6])
+    assert ix.find_matches([5, 6]) == {1: 1, 2: 1}
+    ix.clear_worker(1)
+    assert ix.find_matches([5, 6]) == {2: 1}
+    assert ix.num_blocks == 1
+
+
+def test_prefix_index_refcounted_duplicates():
+    ix = NativePrefixIndex()
+    ix.stored(1, [7])
+    ix.stored(1, [7])     # duplicate stored event
+    ix.removed(1, [7])    # one removal leaves one reference
+    assert ix.find_matches([7]) == {1: 1}
+    ix.removed(1, [7])
+    assert ix.find_matches([7]) == {}
+
+
+def test_hashing_large_sequence_randomised():
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 2**31, size=4096).tolist()
+    bh, sh = block_hashes(toks, 16, T.HASH_SEED)
+    assert [int(v) for v in sh] == T.compute_block_hashes_for_seq(toks, 16)
