@@ -538,11 +538,12 @@ class InferenceEngine(EngineCore):
         """Scatter received KV into a reserved sequence's blocks."""
         await self.inject_kv_blocks(seq.block_table, data)
 
-    def attach_kvbm(self, config=None):
-        """Enable the multi-tier block manager on this engine."""
+    def attach_kvbm(self, config=None, remote=None):
+        """Enable the multi-tier block manager on this engine (optionally
+        with a G4 remote tier)."""
         from ..kvbm.manager import KvbmConfig, KvbmManager
 
-        self.kvbm = KvbmManager(self, config or KvbmConfig())
+        self.kvbm = KvbmManager(self, config or KvbmConfig(), remote=remote)
         return self.kvbm
 
     # --------------------- device execution ----------------------------

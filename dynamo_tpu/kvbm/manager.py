@@ -44,6 +44,44 @@ class KvbmStats:
     onboarded_blocks: int = 0
     onboard_requests: int = 0
     invalidated_pending: int = 0
+    g4_puts: int = 0
+    g4_hits: int = 0
+
+
+class StoreRemoteTier:
+    """G4: cluster-shared KV blocks in the store (ref: block_manager
+    CacheLevel::G4 remote tier, block_manager.rs:62-76 — the reference
+    backs it with NIXL-addressable object storage; here the lease-KV
+    store's value plane). Write-through from the offload tick; any worker
+    can onboard another worker's blocks."""
+
+    KEY_PREFIX = "kvbm/g4/"
+
+    def __init__(self, store, namespace: str = "dynamo"):
+        self.store = store
+        self.prefix = f"{self.KEY_PREFIX}{namespace}/"
+
+    def _key(self, seq_hash: int) -> str:
+        return f"{self.prefix}{seq_hash:016x}"
+
+    async def put(self, seq_hash: int, data: Dict[str, np.ndarray]) -> None:
+        import msgpack
+
+        from ..disagg.protocol import kv_to_wire
+
+        await self.store.put(
+            self._key(seq_hash), msgpack.packb(kv_to_wire(data))
+        )
+
+    async def get(self, seq_hash: int) -> Optional[Dict[str, np.ndarray]]:
+        import msgpack
+
+        from ..disagg.protocol import kv_from_wire
+
+        raw = await self.store.get(self._key(seq_hash))
+        if raw is None:
+            return None
+        return kv_from_wire(msgpack.unpackb(raw, raw=False))
 
 
 @dataclass
@@ -57,13 +95,15 @@ class _Pending:
 class KvbmManager:
     """Attached to an :class:`InferenceEngine` via ``attach_kvbm``."""
 
-    def __init__(self, engine, config: Optional[KvbmConfig] = None):
+    def __init__(self, engine, config: Optional[KvbmConfig] = None,
+                 remote: Optional[StoreRemoteTier] = None):
         self.engine = engine
         self.config = config or KvbmConfig()
         self.host_pool = HostBlockPool(
             self.config.host_blocks, self.config.disk_dir,
             self.config.disk_blocks,
         )
+        self.remote = remote   # G4 tier (None = disabled)
         self.stats = KvbmStats()
         # seq_hash -> candidate awaiting offload; insertion-ordered
         self._pending: Dict[int, _Pending] = {}
@@ -107,10 +147,17 @@ class KvbmManager:
         for i, p in enumerate(batch):
             # copy each [L, KV, bs, hd] block out of the batched gather —
             # a numpy view would pin the whole batch buffer in G2
-            self.host_pool.put(p.seq_hash, {
+            block = {
                 "k": data["k"][:, i].copy(),
                 "v": data["v"][:, i].copy(),
-            })
+            }
+            self.host_pool.put(p.seq_hash, block)
+            if self.remote is not None:
+                try:  # write-through to the cluster-shared G4 tier
+                    await self.remote.put(p.seq_hash, block)
+                    self.stats.g4_puts += 1
+                except Exception:
+                    log.exception("G4 put failed for %x", p.seq_hash)
         self.stats.offloaded_blocks += len(batch)
         return len(batch)
 
@@ -127,6 +174,15 @@ class KvbmManager:
                 if pool.contains(tb.sequence_hash):
                     continue  # native G1 hit — prefix matching will take it
                 data = self.host_pool.get(tb.sequence_hash)
+                if data is None and self.remote is not None:
+                    try:
+                        data = await self.remote.get(tb.sequence_hash)
+                    except Exception:
+                        log.exception("G4 get failed")
+                        data = None
+                    if data is not None:
+                        self.stats.g4_hits += 1
+                        self.host_pool.put(tb.sequence_hash, data)  # promote
                 if data is None:
                     break  # chained hashes: deeper blocks can't hit either
                 bid = pool.adopt(

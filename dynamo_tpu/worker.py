@@ -73,6 +73,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="G2 host-tier capacity in blocks (0 = KVBM off)")
     p.add_argument("--kvbm-disk-dir", default=None)
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
+    p.add_argument("--kvbm-remote", action="store_true",
+                   help="enable the G4 cluster-shared tier in the store")
     return p.parse_args(argv)
 
 
@@ -111,15 +113,20 @@ async def run_worker(args: argparse.Namespace) -> None:
     # seconds of synchronous JAX work (param init, device_put) that would
     # starve the lease keepalive and get the worker evicted at birth.
     engine = InferenceEngine(model_cfg, eng_cfg, params=params)
+    runtime = await DistributedRuntime.from_settings(config)
     if args.kvbm_host_blocks > 0:
-        from .kvbm.manager import KvbmConfig
+        from .kvbm.manager import KvbmConfig, StoreRemoteTier
 
+        remote = None
+        if args.kvbm_remote:
+            remote = StoreRemoteTier(
+                runtime.store, namespace=config.namespace
+            )
         engine.attach_kvbm(KvbmConfig(
             host_blocks=args.kvbm_host_blocks,
             disk_dir=args.kvbm_disk_dir,
             disk_blocks=args.kvbm_disk_blocks,
-        ))
-    runtime = await DistributedRuntime.from_settings(config)
+        ), remote=remote)
 
     handler = None
     component = args.component

@@ -158,3 +158,50 @@ async def test_disk_tier_onboard(tmp_path):
     assert engine.kvbm.host_pool.stats.g3_hits > 0
     assert again == first
     await engine.stop()
+
+
+async def test_g4_remote_tier_cross_engine():
+    """G4: one engine's offloaded blocks are onboarded by a DIFFERENT
+    engine via the cluster-shared store tier — token-exact."""
+    from dynamo_tpu.kvbm.manager import StoreRemoteTier
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    client = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        remote = StoreRemoteTier(client, namespace="t")
+        prompt = list(range(1, 41))
+
+        e1 = InferenceEngine(
+            ModelConfig.tiny(vocab_size=256),
+            EngineConfig(num_blocks=64, block_size=4, max_model_len=128,
+                         max_num_batched_tokens=128, prefill_buckets=(128,),
+                         decode_buckets=(4,), max_num_seqs=4),
+            seed=0,
+        )
+        e1.attach_kvbm(KvbmConfig(host_blocks=64), remote=remote)
+        first = await run_request(e1, prompt)
+        for _ in range(100):
+            if e1.kvbm.stats.g4_puts >= 10:
+                break
+            await asyncio.sleep(0.05)
+        assert e1.kvbm.stats.g4_puts >= 10
+        await e1.stop()
+
+        # fresh engine, same weights (seed), empty local tiers
+        e2 = InferenceEngine(
+            ModelConfig.tiny(vocab_size=256),
+            EngineConfig(num_blocks=64, block_size=4, max_model_len=128,
+                         max_num_batched_tokens=128, prefill_buckets=(128,),
+                         decode_buckets=(4,), max_num_seqs=4),
+            seed=0,
+        )
+        e2.attach_kvbm(KvbmConfig(host_blocks=64), remote=remote)
+        again = await run_request(e2, prompt)
+        assert e2.kvbm.stats.g4_hits > 0
+        assert again == first
+        await e2.stop()
+    finally:
+        await client.close()
+        await server.stop()
