@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Config #4: Llama-3-70B on a multi-host TPU slice (e.g. v5e-64: 16 hosts
+# x 4 chips). Run THIS SCRIPT ON EVERY HOST of the slice; JAX forms the
+# global mesh from the TPU runtime's coordinator, and host 0 additionally
+# runs the store + frontend.
+#
+# Usage (per host):
+#   MODEL_DIR=/models/llama3-70b HOST_INDEX=$(hostname | sed 's/.*-//') \
+#   COORD=host0-ip NUM_HOSTS=16 ./llama70b-multihost.sh
+set -euo pipefail
+MODEL_DIR="${MODEL_DIR:?set MODEL_DIR}"
+HOST_INDEX="${HOST_INDEX:?set HOST_INDEX (0..NUM_HOSTS-1)}"
+COORD="${COORD:?set COORD (host 0 ip)}"
+NUM_HOSTS="${NUM_HOSTS:-16}"
+# global mesh over the slice: dp=1, tp=total chips
+CHIPS_PER_HOST="${CHIPS_PER_HOST:-4}"
+MESH="1,$((NUM_HOSTS * CHIPS_PER_HOST))"
+export DYNTPU_STORE_ADDR="$COORD:4222"
+export JAX_COORDINATOR_ADDRESS="$COORD:8476"
+export JAX_PROCESS_COUNT="$NUM_HOSTS"
+export JAX_PROCESS_INDEX="$HOST_INDEX"
+
+if [ "$HOST_INDEX" = "0" ]; then
+  python -m dynamo_tpu.runtime.store --host 0.0.0.0 --port 4222 &
+  sleep 1
+  python -m dynamo_tpu.frontend --port 8000 --router-mode round_robin &
+fi
+python -m dynamo_tpu.worker --model 70b --weights "$MODEL_DIR" \
+    --mesh "$MESH" --max-model-len 8192 &
+wait

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Realise the planner's VirtualConnector targets as local worker processes
+(the non-K8s orchestrator; role of the reference's operator reconciler for
+``DynamoGraphDeployment`` replica counts).
+
+    python deploy/scripts/scale_watcher.py --store 127.0.0.1:4222 \
+        --component backend -- python -m dynamo_tpu.worker --model tiny ...
+
+Watches ``planner/{ns}/target/{component}`` and spawns/terminates copies of
+the worker command to match the target replica count.
+"""
+
+import argparse
+import asyncio
+import json
+import signal
+import subprocess
+import sys
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store", default="127.0.0.1:4222")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--poll", type=float, default=5.0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command after --")
+    args = p.parse_args()
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        p.error("worker command required after --")
+
+    from dynamo_tpu.runtime.store import StoreClient
+
+    client = await StoreClient.connect(args.store)
+    key = f"planner/{args.namespace}/target/{args.component}"
+    procs: list = []
+    try:
+        while True:
+            raw = await client.get(key)
+            target = int(json.loads(raw)["replicas"]) if raw else len(procs)
+            procs = [pr for pr in procs if pr.poll() is None]
+            while len(procs) < target:
+                print(f"scale up -> {len(procs) + 1}/{target}", flush=True)
+                procs.append(subprocess.Popen(cmd))
+            while len(procs) > target:
+                pr = procs.pop()
+                print(f"scale down -> {len(procs)}/{target}", flush=True)
+                pr.send_signal(signal.SIGTERM)   # graceful drain
+            await asyncio.sleep(args.poll)
+    finally:
+        for pr in procs:
+            pr.terminate()
+        await client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
