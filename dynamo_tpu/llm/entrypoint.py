@@ -59,6 +59,22 @@ def build_routed_pipeline(
     return link(pre, back, Migration(inner, card.migration_limit))
 
 
+def build_local_pipeline(
+    engine: AsyncEngine,
+    tokenizer,
+    model_name: str = "local",
+    max_context_len: int = 8192,
+) -> AsyncEngine:
+    """OpenAI dict in → BackendOutput stream out, over an IN-PROCESS engine
+    (the dynamo-run quickstart shape: no store, no transport — ref:
+    EngineConfig::StaticFull, entrypoint.rs:44)."""
+    pre = Preprocessor(
+        tokenizer, model_name=model_name, max_context_len=max_context_len
+    )
+    back = Backend(tokenizer)
+    return link(pre, back, engine)
+
+
 async def make_kv_sink(
     card: ModelDeploymentCard, client: Client, **router_kwargs
 ):

@@ -1,0 +1,38 @@
+"""dynamo-run-style launcher: batch mode over mocker + arg parsing."""
+
+import json
+import subprocess
+import sys
+
+from dynamo_tpu.run import parse_args
+
+
+def test_parse_io_spec():
+    args = parse_args(["in=text", "out=mocker"])
+    assert args.inp == "text" and args.out == "mocker"
+    args = parse_args(["in=batch:/x.jsonl", "out=engine", "--model", "tiny"])
+    assert args.inp == "batch:/x.jsonl"
+
+
+def test_batch_mode_over_mocker(tmp_path):
+    sys.path.insert(0, "tests")
+    from test_llm_pipeline import byte_tokenizer
+
+    tok = tmp_path / "tok.json"
+    tok.write_text(byte_tokenizer().to_json_str())
+    batch = tmp_path / "batch.jsonl"
+    batch.write_text(
+        json.dumps({"prompt": "hello", "max_tokens": 3}) + "\n"
+        + json.dumps({"token_ids": [5, 6, 7], "max_tokens": 2}) + "\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run",
+         f"in=batch:{batch}", "out=mocker", "--tokenizer", str(tok)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    rows = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    assert len(rows) == 2
+    assert rows[0]["completion_tokens"] == 3
+    assert rows[1]["completion_tokens"] == 2
+    assert rows[1]["token_ids"]
