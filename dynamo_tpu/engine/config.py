@@ -115,6 +115,10 @@ class EngineConfig:
     # with online softmax (ops/paged_attention.py); "einsum" materialises the
     # gathered context (the XLA-fusion reference path)
     attention_impl: str = "pallas"
+    # tokens generated per device roundtrip in decode-only rounds (>1
+    # chains steps on device via lax.scan, amortising host↔device latency;
+    # tokens past a sequence's EOS/capacity inside a window are discarded)
+    decode_steps: int = 1
 
     def __post_init__(self):
         if self.max_num_seqs > max(self.decode_buckets):

@@ -371,6 +371,8 @@ class EngineCore(AsyncEngine):
                     log.exception("kvbm offload tick failed")
 
     def _postprocess(self, batch, results) -> None:
+        """Apply step results. Decode samples are per-seq token WINDOWS
+        (length >= 1); tokens after a mid-window finish are discarded."""
         prefill_samples, decode_samples = results
         self.num_steps += 1
         for chunk, sampled in zip(batch.prefills, prefill_samples):
@@ -385,11 +387,14 @@ class EngineCore(AsyncEngine):
             )
             if completed:
                 self._emit_token(seq)
-        for seq, sampled in zip(batch.decodes, decode_samples):
-            if seq.status == SeqStatus.FINISHED:
-                continue  # aborted while the step was in flight
-            self.scheduler.on_decode_executed(seq, sampled)
-            self._emit_token(seq)
+        for seq, window in zip(batch.decodes, decode_samples):
+            if isinstance(window, int):
+                window = [window]
+            for tok in window:
+                if seq.status == SeqStatus.FINISHED:
+                    break  # aborted / stopped mid-window
+                self.scheduler.on_decode_executed(seq, tok)
+                self._emit_token(seq)
 
     def _emit_token(self, seq: SchedSeq) -> None:
         self.num_generated_tokens += 1
@@ -466,11 +471,18 @@ class InferenceEngine(EngineCore):
             )
         self.params = model_lib.shard_params(params, self.mesh, model_config)
         self.cache = model_lib.shard_cache(
-            model_lib.init_cache(model_config, engine_config), self.mesh
+            model_lib.init_cache(model_config, engine_config), self.mesh,
+            model_config,
         )
         self._step_fn = model_lib.make_step_fn(
             model_config, engine_config, self.mesh
         )
+        self._multistep_fn = None
+        if engine_config.decode_steps > 1:
+            self._multistep_fn = jax.jit(model_lib.raw_multistep_fn(
+                model_config, engine_config, engine_config.decode_steps,
+                self.mesh,
+            ), donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
@@ -593,7 +605,7 @@ class InferenceEngine(EngineCore):
         )
         return int(np.asarray(jax.device_get(sampled))[0])
 
-    def _run_decode(self, seqs: List[SchedSeq]) -> List[int]:
+    def _run_decode(self, seqs: List[SchedSeq]) -> List[List[int]]:
         cfg = self.config
         B = _bucket(len(seqs), cfg.decode_buckets)
         W = _pow2_bucket(
@@ -604,16 +616,36 @@ class InferenceEngine(EngineCore):
         tables = np.zeros((B, W), np.int32)
         temp = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
+        valid_until = np.zeros((B,), np.int32)
+        accepted = []
+        K = cfg.decode_steps
         for i, s in enumerate(seqs):
             tokens[i, 0] = s.all_tokens()[s.num_computed]
             positions[i, 0] = s.num_computed
             tables[i, :len(s.block_table)] = s.block_table
             temp[i] = s.temperature
             top_k[i] = s.top_k
+            # window capped by block capacity and model length; tokens past
+            # the cap scatter to trash on device and are discarded here
+            cap = min(len(s.block_table) * cfg.block_size,
+                      cfg.max_model_len)
+            valid_until[i] = cap
+            accepted.append(max(1, min(K, cap - s.num_computed)))
+        if self._multistep_fn is not None:
+            rngs = jax.random.split(self._next_rng(), K)
+            self.cache, sampled = self._multistep_fn(
+                self.params, self.cache, tokens, positions, tables,
+                valid_until, rngs, temp, top_k,
+            )
+            out = np.asarray(jax.device_get(sampled))   # [K, B]
+            return [
+                [int(out[k, i]) for k in range(accepted[i])]
+                for i in range(len(seqs))
+            ]
         last_idx = np.zeros((B,), np.int32)
         self.cache, sampled = self._step_fn(
             self.params, self.cache, tokens, positions, tables,
             last_idx, self._next_rng(), temp, top_k,
         )
         out = np.asarray(jax.device_get(sampled))
-        return [int(out[i]) for i in range(len(seqs))]
+        return [[int(out[i])] for i in range(len(seqs))]

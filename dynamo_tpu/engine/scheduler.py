@@ -299,13 +299,18 @@ class Scheduler:
         budget = self.config.max_num_batched_tokens
         bs = self.config.block_size
 
-        # 1. decodes: every running sequence advances one token per step
+        # 1. decodes: every running sequence advances up to ``decode_steps``
+        # tokens per round (multi-token windows amortise the host↔device
+        # roundtrip; capacity is reserved for the whole window up front)
+        window = max(1, self.config.decode_steps)
         for seq in list(self.running):
             if budget <= 0:
                 break
             if seq.status is not SeqStatus.RUNNING:
                 continue  # preempted by an earlier seq's _ensure_slot
-            if not self._ensure_slot(seq, seq.num_computed, batch):
+            last_pos = min(seq.num_computed + window,
+                           self.config.max_model_len) - 1
+            if not self._ensure_slot(seq, last_pos, batch):
                 continue  # seq itself was preempted
             budget -= 1
             batch.decodes.append(seq)

@@ -95,7 +95,7 @@ def test_moe_expert_parallel_sharded_step():
         p = params
         if mesh is not None:
             p = model_lib.shard_params(params, mesh, cfg)
-            cache = model_lib.shard_cache(cache, mesh)
+            cache = model_lib.shard_cache(cache, mesh, cfg)
         step = model_lib.make_step_fn(cfg, eng, mesh)
         T = 8
         tokens = np.arange(1, T + 1, dtype=np.int32)[None, :]
