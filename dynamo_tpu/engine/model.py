@@ -376,7 +376,7 @@ def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
     # bf16 x bf16 -> f32 on the MXU; casting the [D, V] head to f32 first
     # would materialise ~1 GB in HBM every step
     return jax.lax.dot_general(
-        h, head.astype(h.dtype), (((1,), (0,)), ((), ())),
+        h, head.astype(h.dtype), (((h.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
