@@ -10,25 +10,55 @@ one chip) and prints ONE JSON line:
 ``vs_baseline`` is measured output-token throughput divided by a GPU-parity
 target for the same model class on one accelerator (vLLM Llama-3.2-1B-class
 on A100: ~1e4 output tok/s at concurrency 64 — the parity bar BASELINE.md
-sets). Extra keys carry TTFT/ITL percentiles for the judge.
+sets). Extra keys carry TTFT/ITL percentiles and an MFU estimate
+(model FLOPs x processed tok/s / chip peak bf16 FLOPs) for the judge.
+
+Robustness contract: this script ALWAYS prints exactly one JSON line on
+stdout, whatever the backend does. The parent process probes the TPU
+backend in a subprocess with a timeout (TPU init has been observed to hang
+indefinitely in some environments), runs the measured loop in a second
+subprocess with a timeout, and falls back to a CPU tiny-model run (with an
+``"error"`` key describing the TPU failure) if the TPU path dies or stalls.
 
 Env overrides: BENCH_ISL, BENCH_OSL, BENCH_CONCURRENCY, BENCH_REQUESTS,
-BENCH_MODEL (tiny|1b).
+BENCH_MODEL (tiny|1b), BENCH_PROBE_TIMEOUT, BENCH_TIMEOUT.
 """
 
 from __future__ import annotations
 
-import asyncio
 import json
 import os
 import random
+import subprocess
+import sys
 import time
-
-import jax
 
 # GPU-parity bar: output tok/s for a 1B-class model on one A100 at
 # concurrency 64 (vLLM-class serving). See BASELINE.md "GPU-parity".
 GPU_PARITY_TOKS = 10_000.0
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+DEFAULT_PEAK = 197e12  # v5e — the BASELINE.md target platform
+CPU_PEAK = 1e12        # nominal, so the CPU-fallback MFU field is defined
+
+
+def _peak_flops(device_kind: str, platform: str) -> float:
+    if platform != "tpu":
+        return CPU_PEAK
+    kind = device_kind.lower()
+    for key in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_FLOPS[key]
+    return DEFAULT_PEAK
 
 
 def _pct(values, q):
@@ -40,10 +70,19 @@ def _pct(values, q):
 
 
 async def run_bench() -> dict:
+    import jax
+
+    # The axon sitecustomize registers the TPU plugin at interpreter startup,
+    # so the JAX_PLATFORMS env var alone cannot force CPU — the config
+    # update can (same trick as tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     from dynamo_tpu.engine.config import EngineConfig, ModelConfig
     from dynamo_tpu.engine.engine import InferenceEngine, Request
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
     on_tpu = platform == "tpu"
 
     model_name = os.environ.get("BENCH_MODEL", "1b" if on_tpu else "tiny")
@@ -75,6 +114,10 @@ async def run_bench() -> dict:
     engine = InferenceEngine(model_cfg, eng_cfg)
     await engine.start()
 
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(engine.params)
+    )
+
     rng = random.Random(0)
     vocab = model_cfg.vocab_size
 
@@ -102,6 +145,8 @@ async def run_bench() -> dict:
             done_tokens[0] += 1
 
     # warmup: trigger every XLA compile (prefill + full decode bucket)
+    import asyncio
+
     await asyncio.gather(*(one_request(-1 - i) for i in range(concurrency)))
     ttfts.clear()
     itls.clear()
@@ -118,21 +163,111 @@ async def run_bench() -> dict:
     elapsed = time.monotonic() - t_start
     await engine.stop()
 
-    toks = done_tokens[0] / elapsed
+    out_toks = done_tokens[0] / elapsed
+    # MFU: every processed token (prefill + decode) costs ~2*n_params
+    # matmul FLOPs; attention-score FLOPs are <5% at these ISLs and are
+    # left out, making this a slight underestimate.
+    processed = num_requests * (isl + osl) / elapsed
+    peak = _peak_flops(getattr(dev, "device_kind", ""), platform)
+    mfu = 2.0 * n_params * processed / peak
     return {
         "metric": f"output tok/s/chip, llama-{model_name} agg greedy "
                   f"ISL={isl} OSL={osl} conc={concurrency} ({platform})",
-        "value": round(toks, 2),
+        "value": round(out_toks, 2),
         "unit": "tok/s/chip",
-        "vs_baseline": round(toks / GPU_PARITY_TOKS, 4),
+        "vs_baseline": round(out_toks / GPU_PARITY_TOKS, 4),
         "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 1),
         "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 1),
         "itl_p50_ms": round(_pct(itls, 50) * 1e3, 2),
         "itl_p99_ms": round(_pct(itls, 99) * 1e3, 2),
         "requests": num_requests,
         "elapsed_s": round(elapsed, 2),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "n_params": n_params,
+        "processed_tok_s": round(processed, 1),
+        "mfu": round(mfu, 4),
     }
 
 
+# --------------------- parent-side orchestration --------------------------
+
+
+def _probe_backend(timeout_s: float) -> tuple:
+    """Ask a subprocess what backend jax gets. Returns (platform, err)."""
+    code = (
+        "import jax, json; d = jax.devices()[0]; "
+        "print('PROBE|' + d.platform + '|' + getattr(d, 'device_kind', ''))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out after {timeout_s:.0f}s"
+    for line in r.stdout.splitlines():
+        if line.startswith("PROBE|"):
+            return line.split("|", 2)[1], None
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return None, (tail[-1] if tail else f"probe rc={r.returncode}")
+
+
+def _run_child(env: dict, timeout_s: float) -> tuple:
+    """Run the measured loop in a subprocess. Returns (result|None, err)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--child"], capture_output=True,
+            text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"bench timed out after {timeout_s:.0f}s"
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                break
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return None, (tail[-1] if tail else f"bench rc={r.returncode}")
+
+
+def main() -> None:
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    bench_timeout = float(os.environ.get("BENCH_TIMEOUT", 2400))
+    errors = []
+
+    platform, err = _probe_backend(probe_timeout)
+    if err:
+        errors.append(f"tpu probe: {err}")
+
+    env = dict(os.environ)
+    if platform is None:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("BENCH_MODEL", "tiny")
+
+    result, err = _run_child(env, bench_timeout)
+    if result is None and env.get("JAX_PLATFORMS") != "cpu":
+        errors.append(f"bench ({platform}): {err}")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_MODEL"] = "tiny"
+        result, err = _run_child(env, bench_timeout)
+    if result is None:
+        errors.append(f"bench (cpu fallback): {err}")
+        result = {
+            "metric": "output tok/s/chip (bench failed)",
+            "value": 0.0, "unit": "tok/s/chip", "vs_baseline": 0.0,
+        }
+    if errors:
+        result["error"] = "; ".join(errors)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    print(json.dumps(asyncio.run(run_bench())))
+    if "--child" in sys.argv:
+        import asyncio
+
+        print(json.dumps(asyncio.run(run_bench())))
+    else:
+        main()

@@ -25,11 +25,14 @@ import asyncio
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Dict, Optional
 
+import uuid
+
 from ..engine.engine import EngineCore, InferenceEngine, Request
 from ..runtime.component import Client
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..utils.logging import get_logger
+from .ici import DevicePlane, default_plane
 from .protocol import kv_from_wire, kv_to_wire
 
 log = get_logger("disagg")
@@ -50,8 +53,12 @@ class PrefillHandler(AsyncEngine):
     """Prefill worker: bounded prefill + KV push-back
     (ref: handlers.py:207 PrefillWorkerHandler)."""
 
-    def __init__(self, engine: InferenceEngine):
+    def __init__(self, engine: InferenceEngine,
+                 plane: Optional[DevicePlane] = None):
         self.engine = engine
+        self.plane = plane if plane is not None else default_plane
+        self.num_device_transfers = 0
+        self.num_relay_transfers = 0
 
     async def generate(
         self, request: Any, context: Context
@@ -65,11 +72,33 @@ class PrefillHandler(AsyncEngine):
             top_k=int(request.get("top_k", 0)),
         )
         seq, first_token = await self.engine.prefill_held(req)
-        try:
-            data = await self.engine.extract_kv(seq)
-        finally:
-            self.engine.release_held(seq)
-        payload = kv_to_wire(data)
+        dst_engine = self.plane.get(xfer.get("plane_id"))
+        dst_ids = list(xfer.get("block_ids") or [])
+        if dst_engine is not None and dst_ids:
+            # device plane: blocks move src→dst on device (ICI), control
+            # message carries only the completion flag — the reference's
+            # "messages carry only block IDs" design taken to its limit
+            try:
+                if len(seq.block_table) < len(dst_ids):
+                    raise RuntimeError(
+                        f"held {len(seq.block_table)} blocks < "
+                        f"{len(dst_ids)} reserved"
+                    )
+                await self.plane.transfer(
+                    self.engine, list(seq.block_table)[: len(dst_ids)],
+                    dst_engine, dst_ids,
+                )
+            finally:
+                self.engine.release_held(seq)
+            self.num_device_transfers += 1
+            payload: Dict[str, Any] = {"device_done": True}
+        else:
+            try:
+                data = await self.engine.extract_kv(seq)
+            finally:
+                self.engine.release_held(seq)
+            self.num_relay_transfers += 1
+            payload = kv_to_wire(data)
         payload["request_id"] = xfer["request_id"]
         # push the blocks into the decode worker's pre-allocated slots
         transport = self.engine_runtime_transport(context)
@@ -105,13 +134,22 @@ class KvInjectHandler(AsyncEngine):
             yield {"ok": False, "error": f"unknown request {rid}"}
             return
         seq, done = pending
+        if request.get("device_done"):
+            # blocks already arrived over the device plane — this is just
+            # the completion signal
+            if not done.done():
+                done.set_result(True)
+            yield {"ok": True}
+            return
         try:
             await self.decode.engine.inject_kv(seq, kv_from_wire(request))
         except Exception as exc:
-            done.set_exception(exc)
+            if not done.done():
+                done.set_exception(exc)
             yield {"ok": False, "error": str(exc)}
             return
-        done.set_result(True)
+        if not done.done():
+            done.set_result(True)
         yield {"ok": True}
 
 
@@ -124,6 +162,7 @@ class DecodeHandler(AsyncEngine):
         engine: InferenceEngine,
         prefill_client: Optional[Client] = None,
         config: Optional[DisaggConfig] = None,
+        plane: Optional[DevicePlane] = None,
     ):
         self.engine = engine
         self.prefill_client = prefill_client
@@ -133,6 +172,20 @@ class DecodeHandler(AsyncEngine):
         self.kv_inject_addr: Optional[str] = None  # set after serving
         self.num_remote_prefills = 0
         self.num_local_prefills = 0
+        # advertise this engine on the device plane so a same-process
+        # prefill worker transfers KV device-to-device instead of relaying
+        self.plane = plane if plane is not None else default_plane
+        self.plane_id: Optional[str] = None
+        if hasattr(engine, "mesh"):  # device engines only (not mocker)
+            self.plane_id = uuid.uuid4().hex
+            self.plane.register(self.plane_id, engine)
+
+    def close(self) -> None:
+        """Drop the device-plane registration (the registry would otherwise
+        pin the engine — and its KV cache — for the process lifetime)."""
+        if self.plane_id is not None:
+            self.plane.unregister(self.plane_id)
+            self.plane_id = None
 
     def inject_handler(self) -> KvInjectHandler:
         return KvInjectHandler(self)
@@ -184,6 +237,8 @@ class DecodeHandler(AsyncEngine):
                 "kv_transfer": {
                     "request_id": context.id,
                     "addr": self.kv_inject_addr,
+                    "plane_id": self.plane_id,
+                    "block_ids": list(seq.block_table),
                 },
             }
             first_token: Optional[int] = None

@@ -1,0 +1,126 @@
+"""Device-native KV block transfer plane (the ICI data plane).
+
+TPU-native replacement for the reference's NIXL GPU-to-GPU path plus its
+CUDA layout-conversion kernels (ref: lib/llm/src/block_manager/
+block_manager.rs:93-98 NIXL registration; lib/llm/src/kernels/
+block_copy.cu:167-309 cross-TP reshape): paged KV blocks move prefill→decode
+**device-to-device** with NO host numpy round-trip, and cross-TP layout
+conversion falls out of sharding propagation instead of a hand-written
+kernel.
+
+Mechanism
+---------
+- Source side gathers the sequence's physical blocks with the jitted
+  block-major gather (``engine.model.make_kv_ops``) — output stays ON
+  DEVICE, sharded over the source mesh's ``tp`` axis.
+- ``jax.device_put(gathered, NamedSharding(dst_mesh, …))`` moves the blocks
+  straight into the destination mesh's layout. The runtime lowers this to
+  direct device-to-device copies (ICI/DMA on TPU); when the prefill and
+  decode engines run different TP degrees, the sharding change IS the
+  resharding — XLA splits/merges the KV-head shards in flight, which is
+  exactly what block_copy.cu does by hand.
+- Destination side scatters into its pre-allocated block slots with the
+  donated jitted scatter; pad rows land in physical block 0 (the trash
+  block) by design.
+
+Both jitted ops run on their engine's single step-executor thread — the
+cache buffer is donated every step, so gather/scatter must serialise with
+step execution (same discipline as ``InferenceEngine.extract_kv_blocks``).
+
+Scope: engines in one process (multi-engine single host — e.g. P and D
+sub-meshes of one chip pod slice). Cross-process transfers ride the host
+relay (``disagg.protocol``) over DCN, as the reference does for
+cross-node NIXL-less fallback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..engine.engine import _pow2_bucket
+from ..utils.logging import get_logger
+
+log = get_logger("disagg.ici")
+
+# data layout produced by the jitted extract: [L, N, KV, bs, hd];
+# KV heads (axis 2) carry the tensor-parallel sharding.
+_DATA_SPEC = P(None, None, "tp", None, None)
+
+
+class DevicePlane:
+    """Process-local registry of engines addressable for device transfer.
+
+    An engine registers under a plane id; a transfer between two registered
+    engines is device-to-device. ``plane_id`` values are advertised in the
+    ``kv_transfer`` control message next to the host-relay address, so a
+    prefill worker sharing the process uses the device plane and any other
+    worker falls back to the relay — mirroring the reference's
+    NIXL-when-registered / bounce-buffer-otherwise split.
+    """
+
+    def __init__(self) -> None:
+        self._engines: Dict[str, object] = {}
+
+    def register(self, plane_id: str, engine) -> None:
+        self._engines[plane_id] = engine
+
+    def unregister(self, plane_id: str) -> None:
+        self._engines.pop(plane_id, None)
+
+    def get(self, plane_id: Optional[str]):
+        if plane_id is None:
+            return None
+        return self._engines.get(plane_id)
+
+    async def transfer(
+        self, src_engine, src_block_ids, dst_engine, dst_block_ids
+    ) -> int:
+        """Move whole KV blocks src→dst on device. Returns bytes moved.
+
+        Block id lists are padded to the same power of two: source pads
+        gather the trash block, destination pads scatter back into the
+        trash block, so no host-side slicing is ever needed.
+        """
+        n = len(src_block_ids)
+        if len(dst_block_ids) != n:
+            raise ValueError(
+                f"block count mismatch: src {n} dst {len(dst_block_ids)}"
+            )
+        if n == 0:
+            return 0
+        m = _pow2_bucket(n)
+        src_ids = np.zeros((m,), np.int32)
+        src_ids[:n] = src_block_ids
+        dst_ids = np.zeros((m,), np.int32)
+        dst_ids[:n] = dst_block_ids
+
+        src_loop = asyncio.get_running_loop()
+
+        def _gather():
+            return src_engine._kv_extract(src_engine.cache, src_ids)
+
+        data = await src_loop.run_in_executor(src_engine._executor, _gather)
+
+        if dst_engine is not src_engine:
+            sharding = NamedSharding(dst_engine.mesh, _DATA_SPEC)
+            # the cross-mesh hop: device-to-device copy + TP reshard in one
+            data = jax.device_put(data, {"k": sharding, "v": sharding})
+
+        def _scatter():
+            dst_engine.cache = dst_engine._kv_inject(
+                dst_engine.cache, dst_ids, data
+            )
+
+        await src_loop.run_in_executor(dst_engine._executor, _scatter)
+        k = data["k"]
+        return 2 * k.size * k.dtype.itemsize  # k + v, padded payload
+
+
+# A process-wide default plane: workers in one process (launcher-spawned
+# P/D engine pairs) find each other without plumbing a registry handle.
+default_plane = DevicePlane()

@@ -172,7 +172,12 @@ async def run_worker(args: argparse.Namespace) -> None:
 
     log.info("worker ready: model=%s mode=%s engine=%s",
              name, args.disagg_mode, eng_cfg)
-    await run_until_shutdown(runtime, engine, served, kv_pub, metrics_pub)
+    try:
+        await run_until_shutdown(runtime, engine, served, kv_pub,
+                                 metrics_pub)
+    finally:
+        if hasattr(handler, "close"):
+            handler.close()
 
 
 def main(argv=None) -> None:
