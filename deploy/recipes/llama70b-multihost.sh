@@ -16,15 +16,16 @@ NUM_HOSTS="${NUM_HOSTS:-16}"
 CHIPS_PER_HOST="${CHIPS_PER_HOST:-4}"
 MESH="1,$((NUM_HOSTS * CHIPS_PER_HOST))"
 export DYNTPU_STORE_ADDR="$COORD:4222"
-export JAX_COORDINATOR_ADDRESS="$COORD:8476"
-export JAX_PROCESS_COUNT="$NUM_HOSTS"
-export JAX_PROCESS_INDEX="$HOST_INDEX"
 
 if [ "$HOST_INDEX" = "0" ]; then
   python -m dynamo_tpu.runtime.store --host 0.0.0.0 --port 4222 &
   sleep 1
   python -m dynamo_tpu.frontend --port 8000 --router-mode round_robin &
 fi
+# host 0 is the leader (schedules + serves); hosts 1..N-1 are followers
+# replaying the leader's step plans over the step_stream endpoint
 python -m dynamo_tpu.worker --model 70b --weights "$MODEL_DIR" \
-    --mesh "$MESH" --max-model-len 8192 &
+    --mesh "$MESH" --max-model-len 8192 \
+    --coordinator "$COORD:8476" --num-hosts "$NUM_HOSTS" \
+    --host-index "$HOST_INDEX" &
 wait

@@ -10,3 +10,17 @@ transport, response streams) follows the reference's protocol shapes
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Honor an explicit CPU request deterministically. Site customizations
+    # that register accelerator plugins at interpreter startup can override
+    # the env var with an "accelerator,cpu" preference list; if the
+    # accelerator's backend init then hangs (e.g. an unreachable TPU
+    # tunnel), every CPU-intended child process hangs with it. The config
+    # update wins over the startup-time preference (same trick as
+    # tests/conftest.py).
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")

@@ -487,6 +487,11 @@ class InferenceEngine(EngineCore):
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
         )
+        # multi-host: the leader's broadcaster observes every executed step
+        # so followers can replay the identical jitted call sequence
+        # (parallel/multihost.py); called on the executor thread
+        self.step_sink: Optional[Callable[[str, Dict[str, np.ndarray]],
+                                          None]] = None
         self._kv_extract, self._kv_inject = model_lib.make_kv_ops(
             engine_config
         )
@@ -599,6 +604,11 @@ class InferenceEngine(EngineCore):
         last_idx = np.array([chunk.length - 1], np.int32)
         temp = np.array([seq.temperature], np.float32)
         top_k = np.array([seq.top_k], np.int32)
+        if self.step_sink is not None:
+            self.step_sink("p", {
+                "tokens": tokens, "positions": positions, "tables": tables,
+                "last_idx": last_idx, "temp": temp, "top_k": top_k,
+            })
         self.cache, sampled = self._step_fn(
             self.params, self.cache, tokens, positions, tables,
             last_idx, self._next_rng(), temp, top_k,
@@ -632,6 +642,12 @@ class InferenceEngine(EngineCore):
             valid_until[i] = cap
             accepted.append(max(1, min(K, cap - s.num_computed)))
         if self._multistep_fn is not None:
+            if self.step_sink is not None:
+                self.step_sink("m", {
+                    "tokens": tokens, "positions": positions,
+                    "tables": tables, "valid_until": valid_until,
+                    "temp": temp, "top_k": top_k,
+                })
             rngs = jax.random.split(self._next_rng(), K)
             self.cache, sampled = self._multistep_fn(
                 self.params, self.cache, tokens, positions, tables,
@@ -643,6 +659,11 @@ class InferenceEngine(EngineCore):
                 for i in range(len(seqs))
             ]
         last_idx = np.zeros((B,), np.int32)
+        if self.step_sink is not None:
+            self.step_sink("d", {
+                "tokens": tokens, "positions": positions, "tables": tables,
+                "last_idx": last_idx, "temp": temp, "top_k": top_k,
+            })
         self.cache, sampled = self._step_fn(
             self.params, self.cache, tokens, positions, tables,
             last_idx, self._next_rng(), temp, top_k,

@@ -1,0 +1,241 @@
+"""Multi-host serving bring-up: jax.distributed + step-plan replication.
+
+The reference reaches multi-node scale by delegating model parallelism to
+its engines and rendezvousing workers over an etcd barrier (ref:
+lib/runtime/src/utils/leader_worker_barrier.rs:125,218; sglang multinode
+flags in components/backends/sglang/docs/dsr1-wideep-h100.md:65-121). Here
+the engine is ours, so multi-host IS the engine's problem, and the
+TPU-native shape is multi-controller SPMD:
+
+- every host process calls ``jax.distributed.initialize`` (coordinator =
+  host 0), after which ``jax.devices()`` is the global chip list and one
+  ``Mesh`` spans the slice;
+- every process must issue the SAME jitted calls in the same order with
+  the same (replicated) host inputs. Scheduling happens once, on host 0
+  (the leader); followers replay the leader's step plans.
+
+Plan replication rides the runtime's TCP response-stream transport: each
+follower opens one long-lived request to the leader's ``step_stream``
+endpoint and the leader streams one plan per executed step — TCP gives
+ordering and reliability, and the store is not on the per-step path.
+Bring-up is gated by the store barrier (``runtime/barrier.py``): the
+leader serves ``step_stream``, waits for every follower to connect, and
+only then registers the model and starts accepting traffic.
+
+Step plans carry the small host-side batch arrays (token ids, positions,
+block tables — a few KB); model state (params, paged KV cache) never
+moves: it lives sharded across the slice and is updated in place by the
+replayed steps. RNG stays in sync because every process derives the same
+key sequence from the same seed, one split per step.
+
+Scope note: disagg KV extract/inject and KVBM host offload are
+single-host features today — a multi-host worker serves the aggregated
+path (the reference's multinode recipes are likewise aggregated
+tensor-parallel serving per worker group).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..runtime.barrier import LeaderBarrier, WorkerBarrier
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..utils.logging import get_logger
+
+log = get_logger("multihost")
+
+
+@dataclass
+class MultihostConfig:
+    coordinator: Optional[str] = None   # "host0:port"
+    num_hosts: int = 1
+    host_index: int = 0
+    barrier_timeout_s: float = 300.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.host_index == 0
+
+
+def initialize_distributed(cfg: MultihostConfig) -> bool:
+    """Join the multi-controller runtime. Must run before any other JAX
+    call (backend init). Returns True when distributed mode is active."""
+    if not cfg.enabled:
+        return False
+    if not cfg.coordinator:
+        raise ValueError("--coordinator is required when --num-hosts > 1")
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_hosts,
+        process_id=cfg.host_index,
+    )
+    log.info(
+        "joined distributed runtime: process %d/%d, %d global devices",
+        cfg.host_index, cfg.num_hosts, len(jax.devices()),
+    )
+    return True
+
+
+# --------------------------- plan encoding -------------------------------
+
+
+def _enc(a: np.ndarray) -> dict:
+    return {"d": a.tobytes(), "t": a.dtype.str, "s": list(a.shape)}
+
+
+def _dec(m: dict) -> np.ndarray:
+    return np.frombuffer(m["d"], np.dtype(m["t"])).reshape(m["s"])
+
+
+def encode_plan(kind: str, arrays: Dict[str, np.ndarray]) -> dict:
+    return {"k": kind, "a": {n: _enc(v) for n, v in arrays.items()}}
+
+
+def decode_plan(plan: dict):
+    return plan["k"], {n: _dec(v) for n, v in plan["a"].items()}
+
+
+# ------------------------------ leader -----------------------------------
+
+
+class StepBroadcaster:
+    """Fans executed step plans out to connected followers.
+
+    ``sink`` is installed as the engine's ``step_sink`` and is called on
+    the engine's step-executor thread; delivery hops to the event loop.
+    """
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._loop = loop or asyncio.get_event_loop()
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self.num_plans = 0
+
+    def sink(self, kind: str, arrays: Dict[str, np.ndarray]) -> None:
+        plan = encode_plan(kind, arrays)
+        self._loop.call_soon_threadsafe(self._fanout, plan)
+
+    def _fanout(self, plan: dict) -> None:
+        self.num_plans += 1
+        for q in self._queues.values():
+            q.put_nowait(plan)
+
+    def subscribe(self, host_index: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[host_index] = q
+        return q
+
+    def unsubscribe(self, host_index: int) -> None:
+        self._queues.pop(host_index, None)
+
+    @property
+    def num_followers(self) -> int:
+        return len(self._queues)
+
+
+class StepStreamHandler(AsyncEngine):
+    """Leader endpoint: one long-lived stream of step plans per follower."""
+
+    def __init__(self, broadcaster: StepBroadcaster):
+        self.broadcaster = broadcaster
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        host_index = int(request["host_index"])
+        queue = self.broadcaster.subscribe(host_index)
+        log.info("follower %d connected to step stream", host_index)
+        try:
+            yield {"hello": True}
+            while True:
+                yield await queue.get()
+        finally:
+            self.broadcaster.unsubscribe(host_index)
+            log.warning("follower %d disconnected", host_index)
+
+
+async def leader_gate(
+    store, cfg: MultihostConfig, broadcaster: StepBroadcaster, name: str
+) -> None:
+    """Barrier: wait until every follower is connected to the step stream
+    before the model is registered (no traffic may be scheduled while a
+    follower is still joining — it would miss plans and diverge)."""
+    barrier = LeaderBarrier(
+        f"multihost/{name}", cfg.num_hosts - 1,
+        timeout_s=cfg.barrier_timeout_s,
+    )
+    await barrier.sync(store, {"model": name, "num_hosts": cfg.num_hosts})
+    if broadcaster.num_followers != cfg.num_hosts - 1:
+        raise RuntimeError(
+            f"barrier passed but only {broadcaster.num_followers}/"
+            f"{cfg.num_hosts - 1} followers on the step stream"
+        )
+    log.info("multihost bring-up complete: %d followers", cfg.num_hosts - 1)
+
+
+# ------------------------------ follower ---------------------------------
+
+
+def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
+    """Execute one leader plan. MUST run on the engine's step-executor
+    thread (cache donation discipline); consumes RNG exactly as the
+    leader's execution path did."""
+    if kind == "m":
+        rngs = jax.random.split(
+            engine._next_rng(), engine.config.decode_steps
+        )
+        engine.cache, _ = engine._multistep_fn(
+            engine.params, engine.cache, arrays["tokens"],
+            arrays["positions"], arrays["tables"], arrays["valid_until"],
+            rngs, arrays["temp"], arrays["top_k"],
+        )
+    else:
+        engine.cache, _ = engine._step_fn(
+            engine.params, engine.cache, arrays["tokens"],
+            arrays["positions"], arrays["tables"], arrays["last_idx"],
+            engine._next_rng(), arrays["temp"], arrays["top_k"],
+        )
+
+
+async def follower_loop(
+    runtime, engine, cfg: MultihostConfig, name: str,
+    component: str = "backend",
+) -> None:
+    """Connect to the leader's step stream, pass the barrier, replay plans
+    until the stream closes (leader death ⇒ the mesh is gone — exit so the
+    supervisor restarts the whole group)."""
+    client = await (
+        runtime.namespace().component(component).endpoint("step_stream")
+        .client()
+    )
+    await client.wait_for_instances(1, timeout_s=cfg.barrier_timeout_s)
+    loop = asyncio.get_running_loop()
+    stream = client.round_robin({"host_index": cfg.host_index}, Context())
+    replayed = 0
+    async for msg in stream:
+        if msg.get("hello"):
+            await WorkerBarrier(
+                f"multihost/{name}", f"host-{cfg.host_index}",
+                timeout_s=cfg.barrier_timeout_s,
+            ).sync(runtime.store, {"host_index": cfg.host_index})
+            log.info("follower %d ready (barrier passed)", cfg.host_index)
+            continue
+        kind, arrays = decode_plan(msg)
+        await loop.run_in_executor(
+            engine._executor, replay_plan, engine, kind, arrays
+        )
+        replayed += 1
+        if replayed == 1 or replayed % 1000 == 0:
+            log.info("follower %d: %d plans replayed", cfg.host_index,
+                     replayed)
+    log.warning("step stream closed after %d plans — leader gone, exiting",
+                replayed)

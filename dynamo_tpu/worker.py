@@ -75,6 +75,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
     p.add_argument("--kvbm-remote", action="store_true",
                    help="enable the G4 cluster-shared tier in the store")
+    # multi-host SPMD (one process per host of a slice; flags default to
+    # the JAX_* env vars so TPU pod launchers can set them uniformly)
+    import os
+
+    p.add_argument("--coordinator",
+                   default=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+                   help="host0 ip:port for jax.distributed (multi-host)")
+    p.add_argument("--num-hosts", type=int,
+                   default=int(os.environ.get("JAX_PROCESS_COUNT", "1")))
+    p.add_argument("--host-index", type=int,
+                   default=int(os.environ.get("JAX_PROCESS_INDEX", "0")))
     return p.parse_args(argv)
 
 
@@ -84,6 +95,21 @@ async def run_worker(args: argparse.Namespace) -> None:
         config.store_addr = args.store_addr
     if args.namespace:
         config.namespace = args.namespace
+
+    from .parallel.multihost import MultihostConfig, initialize_distributed
+
+    mh = MultihostConfig(
+        coordinator=args.coordinator, num_hosts=args.num_hosts,
+        host_index=args.host_index,
+    )
+    # must precede every other JAX call — it decides the backend topology
+    initialize_distributed(mh)
+    if mh.enabled and (args.disagg_mode != "agg"
+                       or args.kvbm_host_blocks > 0):
+        raise SystemExit(
+            "multi-host workers serve the aggregated path only "
+            "(disagg/KVBM are single-host features)"
+        )
 
     dp, tp = (int(x) for x in args.mesh.split(","))
     model_cfg = MODEL_PRESETS[args.model]()
@@ -114,6 +140,39 @@ async def run_worker(args: argparse.Namespace) -> None:
     # starve the lease keepalive and get the worker evicted at birth.
     engine = InferenceEngine(model_cfg, eng_cfg, params=params)
     runtime = await DistributedRuntime.from_settings(config)
+
+    if mh.enabled and not mh.is_leader:
+        # follower: replay the leader's step plans; no serving, no
+        # registration — the leader is the slice's single front door
+        from .parallel.multihost import follower_loop
+
+        log.info("worker ready: model=%s mode=follower host=%d/%d",
+                 name, mh.host_index, mh.num_hosts)
+        try:
+            await follower_loop(runtime, engine, mh, name,
+                                component=args.component)
+        finally:
+            await engine.stop()
+            await runtime.shutdown()
+        return
+
+    if mh.enabled:
+        # leader: stream every executed step to the followers, and gate
+        # model registration on all of them being connected
+        from .parallel.multihost import (
+            StepBroadcaster, StepStreamHandler, leader_gate,
+        )
+
+        broadcaster = StepBroadcaster(asyncio.get_running_loop())
+        engine.step_sink = broadcaster.sink
+        step_ep = (runtime.namespace().component(args.component)
+                   .endpoint("step_stream"))
+        await step_ep.serve_endpoint(
+            StepStreamHandler(broadcaster),
+            advertise_host=args.advertise_host,
+        )
+        await leader_gate(runtime.store, mh, broadcaster, name)
+
     if args.kvbm_host_blocks > 0:
         from .kvbm.manager import KvbmConfig, StoreRemoteTier
 

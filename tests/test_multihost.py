@@ -1,0 +1,133 @@
+"""Multi-host serving: 2 worker processes form one global mesh via
+jax.distributed, rendezvous over the store barrier, and the follower
+replays the leader's step plans so a sharded forward runs across
+processes (ref capability: multinode worker bring-up,
+lib/runtime/src/utils/leader_worker_barrier.rs:125 + sglang multinode
+flags dsr1-wideep-h100.md:65-121)."""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from test_llm_pipeline import byte_tokenizer  # noqa: E402
+from utils import ManagedProcess, free_port  # noqa: E402
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def multihost_cluster(tmp_path):
+    tok = tmp_path / "tokenizer.json"
+    tok.write_text(byte_tokenizer().to_json_str())
+    store_port = free_port()
+    coord_port = free_port()
+    procs = []
+    store = ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store", "--host", "127.0.0.1",
+         "--port", str(store_port)],
+        name="store", ready_pattern=r"listening",
+    )
+    procs.append(store)
+    store.wait_ready(20)
+    env = {"DYNTPU_STORE_ADDR": f"127.0.0.1:{store_port}",
+           # 4 virtual CPU devices per process -> 8 global
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    common = [
+        "-m", "dynamo_tpu.worker", "--model", "tiny", "--model-name",
+        "tiny-mh", "--tokenizer", str(tok), "--block-size", "4",
+        "--num-blocks", "128", "--max-model-len", "256",
+        "--max-batched-tokens", "256", "--mesh", "1,8",
+        "--coordinator", f"127.0.0.1:{coord_port}", "--num-hosts", "2",
+    ]
+    leader = ManagedProcess(
+        [*common, "--host-index", "0"], name="leader", env=env,
+        ready_pattern=r"worker ready.*mode=agg",
+    )
+    procs.append(leader)
+    follower = ManagedProcess(
+        [*common, "--host-index", "1"], name="follower", env=env,
+        ready_pattern=r"follower 1 ready \(barrier passed\)",
+    )
+    procs.append(follower)
+    follower.wait_ready(120)
+    leader.wait_ready(120)
+
+    yield {"store_addr": f"127.0.0.1:{store_port}", "leader": leader,
+           "follower": follower}
+
+    for p in reversed(procs):
+        p.terminate()
+
+
+async def test_multihost_sharded_forward(multihost_cluster):
+    """A request served by the leader drives jitted steps over the global
+    8-device mesh; the follower replays every plan."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    runtime = await DistributedRuntime.from_settings(
+        RuntimeConfig(store_addr=multihost_cluster["store_addr"])
+    )
+    try:
+        client = await (
+            runtime.namespace().component("backend").endpoint("generate")
+            .client()
+        )
+        await client.wait_for_instances(1, timeout_s=60)
+        toks = []
+        async for out in client.round_robin(
+            {"token_ids": list(range(1, 30)), "max_tokens": 6,
+             "ignore_eos": True}, Context(),
+        ):
+            toks.extend(out["token_ids"])
+        assert len(toks) == 6
+    finally:
+        await runtime.shutdown()
+
+    # the follower saw and replayed the leader's plans (1 prefill + decodes)
+    deadline = asyncio.get_event_loop().time() + 20
+    while asyncio.get_event_loop().time() < deadline:
+        if "plans replayed" in multihost_cluster["follower"].log():
+            break
+        await asyncio.sleep(0.5)
+    assert "plans replayed" in multihost_cluster["follower"].log()
+
+
+async def test_multihost_follower_replays_all_steps(multihost_cluster):
+    """Token-exact pressure: several requests; follower stays in lockstep
+    (no crash, no divergence warnings)."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    runtime = await DistributedRuntime.from_settings(
+        RuntimeConfig(store_addr=multihost_cluster["store_addr"])
+    )
+    try:
+        client = await (
+            runtime.namespace().component("backend").endpoint("generate")
+            .client()
+        )
+        await client.wait_for_instances(1, timeout_s=60)
+
+        async def one(i):
+            toks = []
+            async for out in client.round_robin(
+                {"token_ids": list(range(1 + i, 40 + i)), "max_tokens": 4,
+                 "ignore_eos": True}, Context(),
+            ):
+                toks.extend(out["token_ids"])
+            return toks
+
+        results = await asyncio.gather(*(one(i) for i in range(3)))
+        assert all(len(r) == 4 for r in results)
+    finally:
+        await runtime.shutdown()
+
+    flog = multihost_cluster["follower"].log()
+    assert "Traceback" not in flog
+    assert "disconnected" not in multihost_cluster["leader"].log()
