@@ -70,6 +70,8 @@ class PrefillHandler(AsyncEngine):
             max_tokens=1,
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0) or 1.0),
+            seed=request.get("seed"),
         )
         seq, first_token = await self.engine.prefill_held(req)
         dst_engine = self.plane.get(xfer.get("plane_id"))
@@ -217,6 +219,8 @@ class DecodeHandler(AsyncEngine):
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0) or 1.0),
+            seed=request.get("seed"),
             eos_token_ids=tuple(request.get("eos_token_ids", ())),
             ignore_eos=bool(request.get("ignore_eos", False)),
         )
@@ -234,6 +238,8 @@ class DecodeHandler(AsyncEngine):
                 "token_ids": token_ids,
                 "temperature": req.temperature,
                 "top_k": req.top_k,
+                "top_p": req.top_p,
+                "seed": req.seed,
                 "kv_transfer": {
                     "request_id": context.id,
                     "addr": self.kv_inject_addr,

@@ -119,6 +119,12 @@ class EngineConfig:
     # chains steps on device via lax.scan, amortising host↔device latency;
     # tokens past a sequence's EOS/capacity inside a window are discarded)
     decode_steps: int = 1
+    # sequence-parallel prefill: a fresh prompt at least this long is
+    # prefilled as ONE chunk with its T axis sharded over all mesh devices
+    # (ring attention over a flat "sp" view of the dp×tp device set), so
+    # activation memory is O(T / n_devices) and BASELINE's 8k-ISL shapes
+    # don't have to fit one chip's budget. 0 = disabled (chunked prefill).
+    sp_prefill_threshold: int = 0
 
     def __post_init__(self):
         if self.max_num_seqs > max(self.decode_buckets):

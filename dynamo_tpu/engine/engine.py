@@ -42,6 +42,8 @@ class Request:
     max_tokens: int = 64
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
     eos_token_ids: Tuple[int, ...] = ()
     ignore_eos: bool = False
 
@@ -57,6 +59,14 @@ class StepOutput:
     finish_reason: Optional[str] = None
     num_prompt_tokens: int = 0
     cached_prompt_tokens: int = 0
+
+
+def _seed31(seed) -> int:
+    """Map an arbitrary user seed into the int32-safe [0, 2^31) range the
+    device arrays carry (-1 = unseeded). u64-scale seeds are valid on the
+    wire (ref SamplingOptions); an unmasked one would OverflowError inside
+    the step loop and kill every in-flight sequence."""
+    return -1 if seed is None else int(seed) & 0x7FFFFFFF
 
 
 def _bucket(n: int, buckets) -> int:
@@ -154,6 +164,8 @@ class EngineCore(AsyncEngine):
                            else frozenset(request.eos_token_ids)),
             temperature=request.temperature,
             top_k=request.top_k,
+            top_p=request.top_p,
+            seed=_seed31(request.seed),
         )
         if self.kvbm is not None:
             # promote host-tier prefix blocks into G1 before admission so
@@ -209,6 +221,8 @@ class EngineCore(AsyncEngine):
             eos_token_ids=frozenset(),
             temperature=request.temperature,
             top_k=request.top_k,
+            top_p=request.top_p,
+            seed=_seed31(request.seed),
             hold_blocks=True,
         )
         queue: asyncio.Queue = asyncio.Queue()
@@ -241,6 +255,8 @@ class EngineCore(AsyncEngine):
                            else frozenset(request.eos_token_ids)),
             temperature=request.temperature,
             top_k=request.top_k,
+            top_p=request.top_p,
+            seed=_seed31(request.seed),
         )
         if not self.scheduler.reserve(seq):
             return None
@@ -288,6 +304,8 @@ class EngineCore(AsyncEngine):
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0) or 1.0),
+            seed=request.get("seed"),
             eos_token_ids=tuple(request.get("eos_token_ids", ())),
             ignore_eos=bool(request.get("ignore_eos", False)),
         )
@@ -477,6 +495,14 @@ class InferenceEngine(EngineCore):
         self._step_fn = model_lib.make_step_fn(
             model_config, engine_config, self.mesh
         )
+        self._sp_prefill_fn = None
+        self.num_sp_prefills = 0
+        if (engine_config.sp_prefill_threshold > 0
+                and self.mesh.devices.size > 1):
+            self._sp_prefill_fn = model_lib.make_sp_prefill_fn(
+                model_config, engine_config, self.mesh
+            )
+            self.scheduler.sp_enabled = True
         self._multistep_fn = None
         if engine_config.decode_steps > 1:
             self._multistep_fn = jax.jit(model_lib.raw_multistep_fn(
@@ -588,7 +614,17 @@ class InferenceEngine(EngineCore):
     def _run_prefill(self, chunk: PrefillChunk) -> int:
         cfg = self.config
         seq = chunk.seq
-        T = _bucket(chunk.length, cfg.prefill_buckets)
+        use_sp = (
+            self._sp_prefill_fn is not None
+            and chunk.start == 0 and chunk.completes_prompt
+            and chunk.length >= cfg.sp_prefill_threshold
+        )
+        if chunk.length <= max(cfg.prefill_buckets) and not use_sp:
+            T = _bucket(chunk.length, cfg.prefill_buckets)
+        else:
+            # sp full-prompt chunks (and any oversized chunk) bucket to the
+            # next power of two — always divisible by the sp ring size
+            T = _pow2_bucket(chunk.length)
         W = _pow2_bucket(len(seq.block_table), cfg.max_blocks_per_seq)
         tokens = np.zeros((1, T), np.int32)
         positions = np.full((1, T), -1, np.int32)
@@ -604,14 +640,20 @@ class InferenceEngine(EngineCore):
         last_idx = np.array([chunk.length - 1], np.int32)
         temp = np.array([seq.temperature], np.float32)
         top_k = np.array([seq.top_k], np.int32)
+        top_p = np.array([seq.top_p], np.float32)
+        seeds = np.array([seq.seed], np.int32)
         if self.step_sink is not None:
-            self.step_sink("p", {
+            self.step_sink("sp" if use_sp else "p", {
                 "tokens": tokens, "positions": positions, "tables": tables,
                 "last_idx": last_idx, "temp": temp, "top_k": top_k,
+                "top_p": top_p, "seeds": seeds,
             })
-        self.cache, sampled = self._step_fn(
+        step = self._sp_prefill_fn if use_sp else self._step_fn
+        if use_sp:
+            self.num_sp_prefills += 1
+        self.cache, sampled = step(
             self.params, self.cache, tokens, positions, tables,
-            last_idx, self._next_rng(), temp, top_k,
+            last_idx, self._next_rng(), temp, top_k, top_p, seeds,
         )
         return int(np.asarray(jax.device_get(sampled))[0])
 
@@ -626,6 +668,8 @@ class InferenceEngine(EngineCore):
         tables = np.zeros((B, W), np.int32)
         temp = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seeds = np.full((B,), -1, np.int32)
         valid_until = np.zeros((B,), np.int32)
         accepted = []
         K = cfg.decode_steps
@@ -635,6 +679,8 @@ class InferenceEngine(EngineCore):
             tables[i, :len(s.block_table)] = s.block_table
             temp[i] = s.temperature
             top_k[i] = s.top_k
+            top_p[i] = s.top_p
+            seeds[i] = s.seed
             # window capped by block capacity and model length; tokens past
             # the cap scatter to trash on device and are discarded here
             cap = min(len(s.block_table) * cfg.block_size,
@@ -647,11 +693,12 @@ class InferenceEngine(EngineCore):
                     "tokens": tokens, "positions": positions,
                     "tables": tables, "valid_until": valid_until,
                     "temp": temp, "top_k": top_k,
+                    "top_p": top_p, "seeds": seeds,
                 })
             rngs = jax.random.split(self._next_rng(), K)
             self.cache, sampled = self._multistep_fn(
                 self.params, self.cache, tokens, positions, tables,
-                valid_until, rngs, temp, top_k,
+                valid_until, rngs, temp, top_k, top_p, seeds,
             )
             out = np.asarray(jax.device_get(sampled))   # [K, B]
             return [
@@ -663,10 +710,11 @@ class InferenceEngine(EngineCore):
             self.step_sink("d", {
                 "tokens": tokens, "positions": positions, "tables": tables,
                 "last_idx": last_idx, "temp": temp, "top_k": top_k,
+                "top_p": top_p, "seeds": seeds,
             })
         self.cache, sampled = self._step_fn(
             self.params, self.cache, tokens, positions, tables,
-            last_idx, self._next_rng(), temp, top_k,
+            last_idx, self._next_rng(), temp, top_k, top_p, seeds,
         )
         out = np.asarray(jax.device_get(sampled))
         return [[int(out[i])] for i in range(len(seqs))]

@@ -22,7 +22,8 @@ TPU-native. Design points:
   ``jax.sharding.NamedSharding`` annotations over a ``("dp", "tp")`` mesh
   (attention/MLP column-row sharded, KV heads sharded over tp); XLA GSPMD
   inserts the all-reduces the reference gets from NCCL inside vLLM.
-- **Sampling is fused** into the step (greedy / temperature / top-k) so only
+- **Sampling is fused** into the step (greedy / temperature / top-k / top-p,
+  per-request seeds) so only
   B sampled token ids cross the host boundary per step, not ``[B, vocab]``
   logits.
 """
@@ -275,8 +276,17 @@ def forward(
     positions: jax.Array,     # [B, T] int32 absolute, -1 = pad
     block_tables: jax.Array,  # [B, W] int32 physical block ids (0 = trash)
     mesh: Optional[Mesh] = None,
+    ring_mesh: Optional[Mesh] = None,
 ) -> Tuple[Cache, jax.Array]:
     """Run the transformer over a token chunk, updating the paged cache.
+
+    With ``ring_mesh`` set (an "sp" mesh over the same devices), the chunk
+    MUST be a full fresh prompt (start position 0): its T axis is sharded
+    over ``sp``, attention runs as an exact ppermute ring
+    (parallel/ring_attention.py), and GSPMD reshards the chunk's K/V into
+    the head-sharded paged cache — activations cost O(T / sp) per device.
+    Pad tails are safe: ring causal masking is by absolute chunk index, so
+    pad keys (index > every real query) never contaminate real rows.
 
     Returns (updated cache, hidden states [B, T, D]).
     """
@@ -286,7 +296,14 @@ def forward(
     hd = cfg.head_dim_
     H, KV = cfg.num_heads, cfg.num_kv_heads
 
+    use_ring = ring_mesh is not None and T > 1
+
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    if use_ring:
+        # pin activations T-sharded so the whole layer stack stays O(T/sp)
+        h = jax.lax.with_sharding_constraint(
+            h, NamedSharding(ring_mesh, P(None, "sp", None))
+        )
 
     # physical (block, offset) per (b, t); pads go to the trash block 0
     pos_safe = jnp.maximum(positions, 0)
@@ -327,7 +344,18 @@ def forward(
             v.reshape(B * T, KV, hd)
         )
 
-        if use_pallas:
+        if use_ring:
+            from ..parallel.ring_attention import ring_attention
+
+            spec = P(None, "sp", None, None)
+            attn = jax.shard_map(
+                functools.partial(ring_attention, axis_name="sp"),
+                mesh=ring_mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        elif use_pallas:
             attn = _paged_decode_attention(
                 eng, mesh, q, lk, lv, block_tables, seq_lens
             )
@@ -384,7 +412,26 @@ def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
 # ----------------------------- sampling ----------------------------------
 
 
-MAX_TOP_K = 64  # top-k values above this cap are clamped
+MAX_TOP_K = 64  # top-k above this is clamped; the top-p nucleus is found
+                # among these candidates (a >64-token nucleus clamps to 64)
+
+
+def _row_keys(
+    rng: jax.Array, seeds: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Per-row PRNG keys. Seeded rows (seed >= 0) get
+    ``fold_in(PRNGKey(seed), position)`` — deterministic across runs,
+    engine restarts, and batch composition. Unseeded rows (-1) derive from
+    the engine's step rng, decorrelated per row."""
+
+    def mk(seed, pos, i):
+        seeded = jax.random.fold_in(
+            jax.random.PRNGKey(jnp.maximum(seed, 0)), jnp.maximum(pos, 0)
+        )
+        anon = jax.random.fold_in(rng, i)
+        return jnp.where(seed >= 0, seeded, anon)
+
+    return jax.vmap(mk)(seeds, positions, jnp.arange(seeds.shape[0]))
 
 
 def sample(
@@ -392,26 +439,58 @@ def sample(
     rng: jax.Array,
     temperature: jax.Array,  # [B] 0.0 = greedy
     top_k: jax.Array,        # [B] 0 = disabled
+    top_p: jax.Array,        # [B] <=0 or >=1 = disabled
+    seeds: jax.Array,        # [B] per-request seed, -1 = engine rng
+    positions: jax.Array,    # [B] absolute position being sampled
 ) -> jax.Array:
-    """Greedy / temperature / top-k sampling, vectorised over the batch.
+    """Greedy / temperature / top-k / top-p sampling, vectorised over the
+    batch (ref sampling surface: lib/llm/src/protocols/common SamplingOptions
+    — temperature, top_k, top_p, seed).
 
-    The stochastic path (gumbel noise over [B, V] + top-k threshold via
-    ``lax.top_k``, never a full V-sort) runs under ``lax.cond`` so an
-    all-greedy batch — the common serving case — pays only the argmax.
+    The stochastic path runs under ``lax.cond`` so an all-greedy batch — the
+    common serving case — pays only the argmax. Thresholds come from
+    ``lax.top_k`` over MAX_TOP_K candidates, never a full V-sort; the top-p
+    nucleus is therefore capped at MAX_TOP_K tokens (documented clamp, same
+    spirit as the top-k cap). Seeded rows draw from their own key stream so
+    (seed → output tokens) is reproducible regardless of what else is in the
+    batch; sampling is gumbel-max with per-row keys.
     """
     greedy = jnp.argmax(logits, axis=-1)
 
     def stochastic(_):
-        k_vals, _ = jax.lax.top_k(logits, MAX_TOP_K)        # [B, K]
-        safe_k = jnp.clip(top_k, 1, MAX_TOP_K)
-        kth = jnp.take_along_axis(
-            k_vals, (safe_k - 1)[:, None], axis=-1
-        )                                                    # [B, 1]
-        masked = jnp.where(
-            (top_k[:, None] > 0) & (logits < kth), -jnp.inf, logits
-        )
         temp = jnp.maximum(temperature, 1e-6)[:, None]
-        sampled = jax.random.categorical(rng, masked / temp, axis=-1)
+        scaled = logits / temp                               # [B, V]
+        K = min(MAX_TOP_K, logits.shape[-1])
+        k_vals, _ = jax.lax.top_k(scaled, K)                 # [B, K] desc
+        # top-k threshold: the kth largest value (k clamped to K)
+        safe_k = jnp.clip(top_k, 1, K)
+        kth = jnp.take_along_axis(k_vals, (safe_k - 1)[:, None], axis=-1)
+        thresh = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)  # [B, 1]
+        # top-p threshold: smallest candidate still inside the nucleus
+        # (probabilities under the full softmax, candidates in desc order;
+        # the first candidate is always kept)
+        lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+        probs_k = jnp.exp(k_vals - lse)                      # [B, K]
+        cum = jnp.cumsum(probs_k, axis=-1)
+        p_on = (top_p > 0.0) & (top_p < 1.0)                 # [B]
+        keep = (cum - probs_k) < jnp.where(p_on, top_p, 2.0)[:, None]
+        pth = jnp.min(
+            jnp.where(keep, k_vals, jnp.inf), axis=-1, keepdims=True
+        )
+        thresh = jnp.maximum(
+            thresh, jnp.where(p_on[:, None], pth, -jnp.inf)
+        )
+        masked = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+        # gumbel-max with per-row keys (categorical would share one key
+        # across the batch, breaking per-request determinism)
+        keys = _row_keys(rng, seeds, positions)
+        u = jax.vmap(
+            lambda k: jax.random.uniform(
+                k, (logits.shape[-1],),
+                minval=jnp.finfo(jnp.float32).tiny, maxval=1.0,
+            )
+        )(keys)
+        sampled = jnp.argmax(masked - jnp.log(-jnp.log(u)), axis=-1)
         return jnp.where(temperature > 0.0, sampled, greedy)
 
     out = jax.lax.cond(
@@ -424,12 +503,13 @@ def sample(
 
 
 def raw_step_fn(cfg: ModelConfig, eng: EngineConfig,
-                mesh: Optional[Mesh] = None):
+                mesh: Optional[Mesh] = None,
+                ring_mesh: Optional[Mesh] = None):
     """The unjitted unified prefill/decode step.
 
     Signature:
       step(params, cache, tokens[B,T], positions[B,T], block_tables[B,W],
-           last_idx[B], rng, temperature[B], top_k[B])
+           last_idx[B], rng, temperature[B], top_k[B], top_p[B], seeds[B])
         -> (cache, sampled[B])
 
     ``last_idx[b]`` selects which chunk position's logits to sample (the last
@@ -437,15 +517,20 @@ def raw_step_fn(cfg: ModelConfig, eng: EngineConfig,
     """
 
     def step(params, cache, tokens, positions, block_tables,
-             last_idx, rng, temperature, top_k):
+             last_idx, rng, temperature, top_k, top_p, seeds):
         cache, h = forward(
             cfg, eng, params, cache, tokens, positions, block_tables,
-            mesh=mesh,
+            mesh=mesh, ring_mesh=ring_mesh,
         )
         B = tokens.shape[0]
         h_last = h[jnp.arange(B), last_idx]          # [B, D]
         logits = logits_fn(cfg, params, h_last)      # [B, V]
-        sampled = sample(logits, rng, temperature, top_k)
+        pos_last = jnp.take_along_axis(
+            positions, last_idx[:, None], axis=1
+        )[:, 0]
+        sampled = sample(
+            logits, rng, temperature, top_k, top_p, seeds, pos_last
+        )
         return cache, sampled
 
     return step
@@ -462,7 +547,8 @@ def raw_multistep_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
     Signature:
       multistep(params, cache, tokens[B,1], positions[B,1],
                 block_tables[B,W], valid_until[B], rngs[K],
-                temperature[B], top_k[B]) -> (cache, sampled[K, B])
+                temperature[B], top_k[B], top_p[B], seeds[B])
+        -> (cache, sampled[K, B])
 
     Rows whose position reaches ``valid_until`` (capacity / length limit)
     scatter to the trash block and their sampled tokens are garbage — the
@@ -472,7 +558,7 @@ def raw_multistep_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
     """
 
     def multistep(params, cache, tokens, positions, block_tables,
-                  valid_until, rngs, temperature, top_k):
+                  valid_until, rngs, temperature, top_k, top_p, seeds):
         B = tokens.shape[0]
 
         def body(carry, rng_t):
@@ -483,7 +569,9 @@ def raw_multistep_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
                 mesh=mesh,
             )
             logits = logits_fn(cfg, params, h[:, 0])
-            s = sample(logits, rng_t, temperature, top_k)
+            s = sample(
+                logits, rng_t, temperature, top_k, top_p, seeds, pos[:, 0]
+            )
             return (cache, s[:, None], pos + 1), s
 
         (cache, _, _), samples = jax.lax.scan(
@@ -500,6 +588,27 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     params+cache carry their shardings from device_put; data args are small
     host arrays XLA replicates, so no explicit in_shardings are needed."""
     return jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,))
+
+
+def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
+    """Jitted full-prompt sequence-parallel prefill step.
+
+    The same dp×tp device set is viewed as one flat ``sp`` ring; the cache's
+    out_shardings are pinned to the serving layout so subsequent decode
+    steps see an unchanged (donated) cache. SURVEY §5 long-context; exact —
+    ring attention accumulates online softmax in f32.
+    """
+    devices = mesh.devices.flatten()
+    sp_mesh = Mesh(devices, ("sp",))
+    out_shardings = (
+        cache_shardings(mesh, cfg),
+        NamedSharding(mesh, P()),
+    )
+    return jax.jit(
+        raw_step_fn(cfg, eng, mesh, ring_mesh=sp_mesh),
+        donate_argnums=(1,),
+        out_shardings=out_shardings,
+    )
 
 
 # ------------------------ KV block transfer ops ---------------------------

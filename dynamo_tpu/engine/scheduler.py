@@ -200,6 +200,8 @@ class SchedSeq:
     eos_token_ids: frozenset
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
+    seed: int = -1          # -1 = unseeded (engine rng)
     arrival: float = field(default_factory=time.monotonic)
     status: SeqStatus = SeqStatus.WAITING
     output_ids: List[int] = field(default_factory=list)
@@ -277,6 +279,11 @@ class Scheduler:
         self.waiting: Deque[SchedSeq] = deque()
         self.running: List[SchedSeq] = []
         self.stats = SchedulerStats(num_total_blocks=config.num_blocks - 1)
+        # set by the engine once it has actually built an sp prefill step —
+        # config alone isn't enough (a single-device mesh can't ring), and
+        # emitting a whole-prompt chunk the engine must run densely would
+        # bypass max_num_batched_tokens entirely
+        self.sp_enabled = False
 
     # -- admission --
 
@@ -332,15 +339,29 @@ class Scheduler:
                 seq.status = SeqStatus.PREFILL
             target = seq.total_tokens  # prompt (+ outputs when recomputing)
             remaining = target - seq.num_computed
-            # chunk ≤ budget, so a partial chunk always exhausts the budget
-            # and the loop cannot schedule the same token range twice
-            chunk = min(budget, remaining)
+            sp_thresh = self.config.sp_prefill_threshold
+            sp_intent = (self.sp_enabled and sp_thresh
+                         and seq.num_computed == 0
+                         and remaining >= sp_thresh)
+            if sp_intent:
+                # sequence-parallel prefill: the whole fresh prompt goes as
+                # one chunk (the engine shards its T axis over the mesh);
+                # it may exceed the per-step token budget by design
+                chunk = remaining
+            else:
+                # chunk ≤ budget, so a partial chunk always exhausts the
+                # budget and the loop cannot schedule a token range twice
+                chunk = min(budget, remaining)
             # blocks needed to hold [num_computed, num_computed + chunk)
             have = len(seq.block_table)
             need = (seq.num_computed + chunk + bs - 1) // bs - have
             if not self._can_allocate(need):
                 # shrink the chunk to what fits above the watermark
                 chunk = self._max_affordable_chunk(seq, chunk)
+                if sp_intent and chunk < remaining:
+                    # can't host the full prompt → it can't ring; fall back
+                    # to budgeted chunking rather than a giant dense chunk
+                    chunk = min(budget, chunk)
                 if chunk <= 0:
                     break  # pool exhausted; try again next step
                 need = (seq.num_computed + chunk + bs - 1) // bs - have

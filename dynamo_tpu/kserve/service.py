@@ -50,7 +50,7 @@ def _text_from_request(req: pb.ModelInferRequest) -> Optional[str]:
 def _body_from_request(req: pb.ModelInferRequest) -> dict:
     body = {"model": req.model_name, "prompt": _text_from_request(req) or ""}
     params = {k: _param(v) for k, v in req.parameters.items()}
-    for key in ("max_tokens", "temperature", "top_k", "seed"):
+    for key in ("max_tokens", "temperature", "top_k", "top_p", "seed"):
         if params.get(key) is not None:
             body[key] = params[key]
     if params.get("ignore_eos") is not None:

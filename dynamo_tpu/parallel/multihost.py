@@ -189,6 +189,9 @@ def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
     """Execute one leader plan. MUST run on the engine's step-executor
     thread (cache donation discipline); consumes RNG exactly as the
     leader's execution path did."""
+    B = arrays["temp"].shape[0]
+    top_p = arrays.get("top_p", np.ones((B,), np.float32))
+    seeds = arrays.get("seeds", np.full((B,), -1, np.int32))
     if kind == "m":
         rngs = jax.random.split(
             engine._next_rng(), engine.config.decode_steps
@@ -196,13 +199,15 @@ def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
         engine.cache, _ = engine._multistep_fn(
             engine.params, engine.cache, arrays["tokens"],
             arrays["positions"], arrays["tables"], arrays["valid_until"],
-            rngs, arrays["temp"], arrays["top_k"],
+            rngs, arrays["temp"], arrays["top_k"], top_p, seeds,
         )
     else:
-        engine.cache, _ = engine._step_fn(
+        fn = engine._sp_prefill_fn if kind == "sp" else engine._step_fn
+        engine.cache, _ = fn(
             engine.params, engine.cache, arrays["tokens"],
             arrays["positions"], arrays["tables"], arrays["last_idx"],
             engine._next_rng(), arrays["temp"], arrays["top_k"],
+            top_p, seeds,
         )
 
 

@@ -135,6 +135,7 @@ def test_step_fn_greedy_continuation(setup):
         params, cache, jnp.asarray(tokens), jnp.asarray(pos),
         jnp.asarray(tbl), jnp.asarray([6]), jax.random.PRNGKey(0),
         jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.float32), jnp.full((1,), -1, jnp.int32),
     )
     ref = dense_reference(cfg, params, prompt)
     assert int(sampled[0]) == int(jnp.argmax(ref[-1]))

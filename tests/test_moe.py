@@ -106,6 +106,7 @@ def test_moe_expert_parallel_sharded_step():
             p, cache, tokens, positions, tables,
             np.array([T - 1], np.int32), jax.random.PRNGKey(1),
             np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+            np.ones((1,), np.float32), np.full((1,), -1, np.int32),
         )
         return int(np.asarray(jax.device_get(sampled))[0])
 
