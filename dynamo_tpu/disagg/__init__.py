@@ -10,13 +10,16 @@ gather/scatter (``engine.model.make_kv_ops``) host-relayed over the TCP
 transport; same-mesh transfers ride ICI through the identical jitted ops.
 """
 
-from .handlers import DecodeHandler, DisaggConfig, PrefillHandler
+from .handlers import (
+    DecodeHandler, DisaggConfig, PrefillHandler, PrefillQueueWorker,
+)
 from .protocol import kv_from_wire, kv_to_wire
 
 __all__ = [
     "DecodeHandler",
     "DisaggConfig",
     "PrefillHandler",
+    "PrefillQueueWorker",
     "kv_from_wire",
     "kv_to_wire",
 ]

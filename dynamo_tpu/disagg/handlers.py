@@ -22,6 +22,7 @@ control messages carry only block metadata.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Dict, Optional
 
@@ -47,6 +48,17 @@ class DisaggConfig:
     min_remote_prefill_tokens: int = 32
     # refuse remote prefill when the decode pool is above this usage
     max_reserve_usage: float = 0.95
+    # queue mode (ref: the JetStream pull-queue "Prefill Queue" in
+    # docs/architecture/disagg_serving.md; nats.rs:426): decode workers
+    # q_push prefill work onto the store work queue and prefill workers
+    # q_pop it — slow prefill workers naturally take fewer items than fast
+    # ones, and the queue depth is a direct backlog signal for the planner.
+    # False = direct round-robin push (the legacy/fallback path).
+    use_queue: bool = False
+    queue_name: str = "prefill_queue"
+    # how long decode waits for the queued prefill before falling back to
+    # a local prefill
+    queue_wait_s: float = 60.0
 
 
 class PrefillHandler(AsyncEngine):
@@ -60,12 +72,37 @@ class PrefillHandler(AsyncEngine):
         self.num_device_transfers = 0
         self.num_relay_transfers = 0
 
-    async def generate(
-        self, request: Any, context: Context
-    ) -> AsyncIterator[dict]:
+    async def _still_pending(self, xfer: Dict[str, Any]) -> bool:
+        """Ask the decode worker whether the request is still waiting.
+
+        The device-plane transfer writes straight into the reserved block
+        ids, so a stale work item (decode timed out, blocks reallocated)
+        would corrupt another request's KV. The query also marks the
+        request transfer-in-flight on the decode side, so decode's timeout
+        path waits for completion instead of freeing blocks mid-transfer.
+        """
+        try:
+            transport = self.engine_runtime_transport(None)
+            async for ack in transport.generate(
+                xfer["addr"],
+                {"request_id": xfer["request_id"], "query": True},
+                Context(),
+            ):
+                return bool(ack.get("ok"))
+        except Exception:
+            log.exception("liveness query to decode failed")
+        return False
+
+    async def execute(
+        self, request: Dict[str, Any], *, include_token: bool
+    ) -> int:
+        """Run one bounded prefill and push its KV into the decode worker's
+        reserved blocks. Returns the first sampled token; with
+        ``include_token`` the token rides the inject payload (queue mode has
+        no response stream to carry it)."""
         xfer: Dict[str, Any] = request.get("kv_transfer") or {}
         req = Request(
-            request_id=context.id,
+            request_id=xfer.get("request_id") or f"prefill-{uuid.uuid4().hex}",
             token_ids=list(request["token_ids"]),
             max_tokens=1,
             temperature=float(request.get("temperature", 0.0)),
@@ -76,6 +113,12 @@ class PrefillHandler(AsyncEngine):
         seq, first_token = await self.engine.prefill_held(req)
         dst_engine = self.plane.get(xfer.get("plane_id"))
         dst_ids = list(xfer.get("block_ids") or [])
+        if (dst_engine is not None and dst_ids and include_token
+                and not await self._still_pending(xfer)):
+            # queue mode: the item may be stale (decode gave up and its
+            # reserved blocks were recycled) — never write into them
+            self.engine.release_held(seq)
+            raise RuntimeError("decode no longer waiting — dropping item")
         if dst_engine is not None and dst_ids:
             # device plane: blocks move src→dst on device (ICI), control
             # message carries only the completion flag — the reference's
@@ -102,21 +145,127 @@ class PrefillHandler(AsyncEngine):
             self.num_relay_transfers += 1
             payload = kv_to_wire(data)
         payload["request_id"] = xfer["request_id"]
+        if include_token:
+            payload["first_token"] = first_token
         # push the blocks into the decode worker's pre-allocated slots
-        transport = self.engine_runtime_transport(context)
+        transport = self.engine_runtime_transport(None)
         async for ack in transport.generate(xfer["addr"], payload, Context()):
             if not ack.get("ok", False):
                 raise RuntimeError(f"kv inject rejected: {ack}")
+        return first_token
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        request = dict(request)
+        xfer = dict(request.get("kv_transfer") or {})
+        xfer.setdefault("request_id", context.id)
+        request["kv_transfer"] = xfer
+        first_token = await self.execute(request, include_token=False)
         yield {"token_ids": [first_token], "finished": True,
                "finish_reason": "remote_prefill"}
 
     # seam for tests / runtime injection
-    def engine_runtime_transport(self, context: Context):
+    def engine_runtime_transport(self, context: Optional[Context]):
         from ..runtime.transport import TransportClient
 
         if not hasattr(self, "_transport"):
             self._transport = TransportClient()
         return self._transport
+
+
+class PrefillQueueWorker:
+    """Pull-mode prefill consumer (ref: the JetStream prefill queue,
+    lib/runtime/src/transports/nats.rs:426): pops work items from the store
+    work queue and executes them via :class:`PrefillHandler`. A worker only
+    takes what it can chew (``max_inflight``), so heterogeneous prefill
+    workers self-balance and the queue length is the backlog signal.
+    On failure it reports the error to the decode worker's inject endpoint
+    so decode falls back to local prefill immediately instead of timing
+    out."""
+
+    def __init__(self, handler: PrefillHandler, store,
+                 queue_name: str = "prefill_queue", max_inflight: int = 2):
+        self.handler = handler
+        self.store = store
+        self.queue_name = queue_name
+        self.max_inflight = max_inflight
+        self.num_pulled = 0
+        self.num_failed = 0
+        self.num_expired = 0
+        self._task: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._pull_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for t in list(self._inflight):
+            t.cancel()
+
+    async def _pull_loop(self) -> None:
+        import msgpack
+
+        sem = asyncio.Semaphore(self.max_inflight)
+        while True:
+            await sem.acquire()
+            try:
+                raw = await self.store.q_pop(self.queue_name, timeout_s=30.0)
+            except Exception:
+                sem.release()
+                log.exception("prefill queue pop failed — retrying")
+                await asyncio.sleep(0.5)
+                continue
+            if raw is None:
+                sem.release()
+                continue
+            try:
+                item = msgpack.unpackb(raw, raw=False)
+            except Exception:
+                sem.release()
+                log.exception("bad prefill queue item — dropping")
+                continue
+            deadline = item.get("queue_deadline")
+            if deadline is not None and time.time() > float(deadline):
+                # decode already gave up on this item — don't prefill into
+                # block ids that may have been recycled
+                sem.release()
+                self.num_expired += 1
+                log.warning("dropping expired prefill item %s",
+                            (item.get("kv_transfer") or {}).get("request_id"))
+                continue
+            task = asyncio.create_task(self._run_one(item, sem))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _run_one(self, item: dict, sem: asyncio.Semaphore) -> None:
+        try:
+            self.num_pulled += 1
+            await self.handler.execute(item, include_token=True)
+        except Exception as exc:
+            self.num_failed += 1
+            log.exception("queued prefill failed — notifying decode")
+            await self._report_failure(item, exc)
+        finally:
+            sem.release()
+
+    async def _report_failure(self, item: dict, exc: Exception) -> None:
+        xfer = item.get("kv_transfer") or {}
+        addr, rid = xfer.get("addr"), xfer.get("request_id")
+        if not addr or not rid:
+            return
+        try:
+            transport = self.handler.engine_runtime_transport(None)
+            async for _ in transport.generate(
+                addr, {"request_id": rid, "error": str(exc)}, Context()
+            ):
+                break
+        except Exception:
+            log.exception("failure report to decode failed")
 
 
 class KvInjectHandler(AsyncEngine):
@@ -136,11 +285,29 @@ class KvInjectHandler(AsyncEngine):
             yield {"ok": False, "error": f"unknown request {rid}"}
             return
         seq, done = pending
+        if request.get("query"):
+            # prefill worker asking "still waiting?" before a device-plane
+            # write; marking in-flight makes decode's timeout path wait for
+            # the transfer instead of freeing the target blocks under it
+            self.decode.inflight.add(rid)
+            yield {"ok": True}
+            return
+        if request.get("error"):
+            # queue-mode prefill worker reporting failure: wake the waiting
+            # decode handler so it falls back to local prefill immediately
+            if not done.done():
+                done.set_exception(RuntimeError(
+                    f"remote prefill failed: {request['error']}"
+                ))
+            yield {"ok": True}
+            return
+        # queue mode has no response stream — the first token rides here
+        result = request.get("first_token", True)
         if request.get("device_done"):
             # blocks already arrived over the device plane — this is just
             # the completion signal
             if not done.done():
-                done.set_result(True)
+                done.set_result(result)
             yield {"ok": True}
             return
         try:
@@ -151,7 +318,7 @@ class KvInjectHandler(AsyncEngine):
             yield {"ok": False, "error": str(exc)}
             return
         if not done.done():
-            done.set_result(True)
+            done.set_result(result)
         yield {"ok": True}
 
 
@@ -165,15 +332,25 @@ class DecodeHandler(AsyncEngine):
         prefill_client: Optional[Client] = None,
         config: Optional[DisaggConfig] = None,
         plane: Optional[DevicePlane] = None,
+        store=None,
     ):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
+        self.store = store  # required for queue mode (use_queue)
         # request_id -> (reserved seq, inject-complete future)
         self.pending: Dict[str, tuple] = {}
+        # request ids with a device-plane transfer in flight (the prefill
+        # worker's liveness query marks these; our timeout path then grants
+        # a grace period instead of freeing blocks mid-write)
+        self.inflight: set = set()
+        self._depth_task: Optional[asyncio.Task] = None
         self.kv_inject_addr: Optional[str] = None  # set after serving
         self.num_remote_prefills = 0
         self.num_local_prefills = 0
+        # backlog signal for the planner, refreshed on every enqueue
+        # (published via WorkerMetricsPublisher extra_fn)
+        self.last_queue_depth = 0
         # advertise this engine on the device plane so a same-process
         # prefill worker transfers KV device-to-device instead of relaying
         self.plane = plane if plane is not None else default_plane
@@ -188,20 +365,58 @@ class DecodeHandler(AsyncEngine):
         if self.plane_id is not None:
             self.plane.unregister(self.plane_id)
             self.plane_id = None
+        if self._depth_task is not None:
+            self._depth_task.cancel()
+            self._depth_task = None
 
     def inject_handler(self) -> KvInjectHandler:
         return KvInjectHandler(self)
 
     def _should_remote_prefill(self, token_ids: list) -> bool:
-        if self.prefill_client is None or self.kv_inject_addr is None:
+        if self.kv_inject_addr is None:
             return False
-        if not self.prefill_client.instance_ids():
-            return False
+        if self.config.use_queue:
+            if self.store is None:
+                return False
+            # with zero live prefill workers nobody will ever pop the
+            # queue — go local immediately rather than stalling every
+            # long prompt for queue_wait_s (the client is optional so
+            # store-only test rigs still work)
+            if (self.prefill_client is not None
+                    and not self.prefill_client.instance_ids()):
+                return False
+        else:
+            if (self.prefill_client is None
+                    or not self.prefill_client.instance_ids()):
+                return False
         if len(token_ids) < self.config.min_remote_prefill_tokens:
             return False
         if self.engine.stats.kv_usage > self.config.max_reserve_usage:
             return False
         return True
+
+    def metrics_extra(self) -> dict:
+        """Merged into the worker's load-metrics snapshot (planner input)."""
+        return {"prefill_queue_depth": self.last_queue_depth}
+
+    def start_depth_monitor(self, interval_s: float = 1.0) -> None:
+        """Keep ``last_queue_depth`` fresh even when no pushes happen —
+        a metric sampled only at enqueue time would report phantom backlog
+        forever after a burst drains."""
+        if self._depth_task is None and self.store is not None:
+            self._depth_task = asyncio.create_task(
+                self._depth_loop(interval_s)
+            )
+
+    async def _depth_loop(self, interval_s: float) -> None:
+        while True:
+            try:
+                self.last_queue_depth = await self.store.q_len(
+                    self.config.queue_name
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(interval_s)
 
     async def generate(
         self, request: Any, context: Context
@@ -248,13 +463,50 @@ class DecodeHandler(AsyncEngine):
                 },
             }
             first_token: Optional[int] = None
-            async for item in self.prefill_client.round_robin(
-                prefill_request, context
-            ):
-                first_token = item["token_ids"][0]
-            if first_token is None:
-                raise RuntimeError("prefill worker returned no token")
-            await asyncio.wait_for(done, timeout=120.0)
+            if self.config.use_queue:
+                # queue mode: enqueue and wait — the inject payload carries
+                # the first token (or the failure) back to us
+                import msgpack
+
+                prefill_request["queue_deadline"] = (
+                    time.time() + self.config.queue_wait_s
+                )
+                await self.store.q_push(
+                    self.config.queue_name, msgpack.packb(prefill_request)
+                )
+                try:
+                    self.last_queue_depth = await self.store.q_len(
+                        self.config.queue_name
+                    )
+                except Exception:
+                    pass
+                try:
+                    result = await asyncio.wait_for(
+                        done, timeout=self.config.queue_wait_s
+                    )
+                except asyncio.TimeoutError:
+                    if context.id not in self.inflight:
+                        raise
+                    # a device-plane transfer is mid-write into our
+                    # reserved blocks — freeing them now would hand
+                    # corrupted blocks to the next request; grant a grace
+                    # window for the transfer to land
+                    result = await asyncio.wait_for(done, timeout=30.0)
+                # bool is an int subclass — require a real token id, not
+                # the legacy True completion marker
+                if type(result) is not int:
+                    raise RuntimeError(
+                        "queued prefill completed without a first token"
+                    )
+                first_token = result
+            else:
+                async for item in self.prefill_client.round_robin(
+                    prefill_request, context
+                ):
+                    first_token = item["token_ids"][0]
+                if first_token is None:
+                    raise RuntimeError("prefill worker returned no token")
+                await asyncio.wait_for(done, timeout=120.0)
             self.num_remote_prefills += 1
             log.debug("remote prefill complete: %s (%d tokens)",
                       context.id, len(token_ids))
@@ -265,12 +517,14 @@ class DecodeHandler(AsyncEngine):
             log.exception("remote prefill failed — falling back to local")
             self.engine.cancel_reservation(seq)
             self.pending.pop(context.id, None)
+            self.inflight.discard(context.id)
             self.num_local_prefills += 1
             async for out in self.engine.generate(request, context):
                 yield out
             return
         finally:
             self.pending.pop(context.id, None)
+            self.inflight.discard(context.id)
 
         async def _on_stop() -> None:
             await context.wait_stopped()

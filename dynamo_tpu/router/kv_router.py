@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import asyncio
 import random
-from typing import Any, AsyncIterator, Dict, Optional
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional, Set
 
 import msgpack
 
@@ -28,6 +29,10 @@ log = get_logger("kv_router")
 
 KV_EVENTS_SUBJECT = "kv_events"         # ref: kv_router.rs:60
 LOAD_METRICS_SUBJECT = "load_metrics"   # ref: kv_router.rs:57
+# inter-replica routing lifecycle sync (ref: kv_router.rs:65-73
+# prefill_events + active_sequences_events — one subject here, the event
+# carries the lifecycle kind)
+ROUTER_SYNC_SUBJECT = "router_sync"
 
 
 class KvRouter:
@@ -63,6 +68,18 @@ class KvRouter:
         self._stats_task: Optional[asyncio.Task] = None
         self._stream = None
         self._stats_stream = None
+        # replica sync (ref: kv_router.rs:65-73)
+        self.router_id = uuid.uuid4().hex
+        self._sync_out: "asyncio.Queue[dict]" = asyncio.Queue()
+        self._sync_pub_task: Optional[asyncio.Task] = None
+        self._sync_sub_task: Optional[asyncio.Task] = None
+        self._sync_stream = None
+        # request ids applied from each peer, so a lost subscription can
+        # roll back exactly the load we attributed to that peer
+        self._peer_requests: Dict[str, Set[str]] = {}
+        self.num_peer_events = 0
+        self._events_at_snapshot = 0
+        self._snapshot_task: Optional[asyncio.Task] = None
         client.on_instance_removed.append(self._on_worker_removed)
 
     # -- lifecycle --
@@ -76,22 +93,43 @@ class KvRouter:
             self._stats_task = asyncio.create_task(
                 self._stats_loop(self._stats_stream)
             )
+        if self.config.replica_sync and self._sync_sub_task is None:
+            self._sync_stream = await store.subscribe(
+                self.component.event_subject(ROUTER_SYNC_SUBJECT)
+            )
+            self._sync_sub_task = asyncio.create_task(
+                self._sync_loop(self._sync_stream)
+            )
+            self._sync_pub_task = asyncio.create_task(self._sync_publisher())
         if self.indexer is None or self._sub_task is not None:
             return
+        # subscribe BEFORE loading the snapshot: events published while the
+        # snapshot is read buffer in the watch stream and are consumed only
+        # after the (older) snapshot is applied — so removals that race the
+        # warm-start still land on top, in order
         self._stream = await store.subscribe(
             self.component.event_subject(KV_EVENTS_SUBJECT)
         )
+        await self._load_snapshot()
         self._sub_task = asyncio.create_task(self._event_loop(self._stream))
 
     async def stop(self) -> None:
+        if self._snapshot_task is not None:
+            try:
+                await self._snapshot_task
+            except Exception:
+                pass
+            self._snapshot_task = None
         for task_attr, stream_attr in (
             ("_sub_task", "_stream"), ("_stats_task", "_stats_stream"),
+            ("_sync_sub_task", "_sync_stream"),
+            ("_sync_pub_task", None),
         ):
             task = getattr(self, task_attr)
             if task is not None:
                 task.cancel()
                 setattr(self, task_attr, None)
-            stream = getattr(self, stream_attr)
+            stream = getattr(self, stream_attr) if stream_attr else None
             if stream is not None:
                 try:
                     await stream.cancel()
@@ -131,6 +169,7 @@ class KvRouter:
             try:
                 payload = msgpack.unpackb(event["value"], raw=False)
                 self.indexer.apply_event(RouterEvent.from_dict(payload))
+                self._maybe_snapshot()
             except Exception:
                 log.exception("bad kv event")
 
@@ -149,6 +188,141 @@ class KvRouter:
                 self.worker_stats[int(snap["worker_id"])] = snap
             except Exception:
                 log.exception("bad load metrics event")
+
+    # -- replica sync (ref: kv_router.rs:65-73) --
+
+    def _sync_emit(self, kind: str, request_id: str, worker_id: int = 0,
+                   isl: int = 0, overlap: int = 0) -> None:
+        if self.config.replica_sync:
+            self._sync_out.put_nowait({
+                "router_id": self.router_id, "kind": kind,
+                "request_id": request_id, "worker_id": worker_id,
+                "isl": isl, "overlap": overlap,
+            })
+
+    async def _sync_publisher(self) -> None:
+        store = self.client.runtime.store
+        subject = self.component.event_subject(ROUTER_SYNC_SUBJECT)
+        while True:
+            msg = await self._sync_out.get()
+            try:
+                await store.publish(subject, msgpack.packb(msg))
+            except Exception:
+                log.exception("router sync publish failed")
+
+    async def _sync_loop(self, stream) -> None:
+        subject = self.component.event_subject(ROUTER_SYNC_SUBJECT)
+        while True:
+            event = await stream.next()
+            if event is None or event["event"] == "dropped":
+                # we may have missed peer lifecycle events (including
+                # frees) — roll back everything we attributed to peers so
+                # load can't leak, then resubscribe
+                log.warning("router_sync subscription lost — "
+                            "dropping peer-attributed load")
+                for rids in self._peer_requests.values():
+                    for rid in rids:
+                        self.loads.free(rid)
+                self._peer_requests.clear()
+                await stream.cancel()
+                stream = self._sync_stream = await self._resubscribe(subject)
+                continue
+            if event["event"] != "msg":
+                continue
+            try:
+                msg = msgpack.unpackb(event["value"], raw=False)
+                self._apply_peer_event(msg)
+            except Exception:
+                log.exception("bad router sync event")
+
+    def _apply_peer_event(self, msg: dict) -> None:
+        if msg.get("router_id") == self.router_id:
+            return  # our own publication echoed back
+        rid = msg["request_id"]
+        kind = msg["kind"]
+        peers = self._peer_requests.setdefault(msg["router_id"], set())
+        self.num_peer_events += 1
+        if kind == "add":
+            peers.add(rid)
+            self.loads.add(rid, int(msg["worker_id"]), int(msg["isl"]),
+                           int(msg["overlap"]))
+        elif kind == "prefill_done":
+            self.loads.prefill_done(rid)
+        elif kind == "free":
+            peers.discard(rid)
+            self.loads.free(rid)
+
+    # -- index snapshot persistence (ref: kv_router.rs:979, indexer.rs:450) --
+
+    def _snapshot_key(self) -> str:
+        return f"v1/router/{self.component.path}/radix-snapshot"
+
+    def _maybe_snapshot(self) -> None:
+        thresh = self.config.snapshot_threshold
+        if (not thresh or self.indexer is None
+                or self._snapshot_task is not None):
+            return
+        if self.indexer.events_applied - self._events_at_snapshot < thresh:
+            return
+        self._events_at_snapshot = self.indexer.events_applied
+        self._snapshot_task = asyncio.create_task(self._write_snapshot())
+
+    async def _write_snapshot(self) -> None:
+        """Persist the prefix index under a store lock so exactly one
+        replica writes (ref: the etcd-locked radix-bucket writer)."""
+        store = self.client.runtime.store
+        lock_name = self._snapshot_key()
+        try:
+            if not await store.lock(lock_name):
+                return  # a peer replica is writing — theirs is as good
+            try:
+                payload = msgpack.packb({
+                    # str keys: msgpack's strict_map_key rejects int keys
+                    "workers": {
+                        str(w): sorted(hs)
+                        for w, hs in self.indexer._hashes_of.items() if hs
+                    },
+                    "router_id": self.router_id,
+                })
+                await store.put(self._snapshot_key(), payload)
+            finally:
+                await store.unlock(lock_name)
+        except Exception:
+            log.exception("index snapshot write failed")
+        finally:
+            self._snapshot_task = None
+
+    async def _load_snapshot(self) -> None:
+        """Warm-start the prefix index from the persisted snapshot, keeping
+        only workers that are still registered."""
+        store = self.client.runtime.store
+        try:
+            raw = await store.get(self._snapshot_key())
+        except Exception:
+            log.exception("index snapshot read failed")
+            return
+        if not raw:
+            return
+        try:
+            snap = msgpack.unpackb(raw, raw=False)
+            try:  # give discovery a moment so the liveness filter is real
+                await self.client.wait_for_instances(1, timeout_s=2.0)
+            except Exception:
+                pass
+            live = set(self.client.instance_ids())
+            loaded = 0
+            for w, hashes in snap.get("workers", {}).items():
+                w = int(w)
+                if live and w not in live:
+                    continue  # dead worker — its blocks are gone
+                self.indexer.apply_event(RouterEvent(
+                    worker_id=w, kind="stored", blocks=tuple(hashes),
+                ))
+                loaded += len(hashes)
+            self._events_at_snapshot = self.indexer.events_applied
+            log.info("index warm-start: %d blocks from snapshot", loaded)
+        except Exception:
+            log.exception("bad index snapshot — starting cold")
 
     def _on_worker_removed(self, worker_id: int) -> None:
         if self.indexer is not None:
@@ -201,6 +375,8 @@ class KvRouter:
         )
         self.loads.add(request_id, sel.worker_id, len(token_ids),
                        sel.overlap_blocks)
+        self._sync_emit("add", request_id, sel.worker_id, len(token_ids),
+                        sel.overlap_blocks)
         if self.approx is not None:
             self.approx.record_routing_decision(sel.worker_id, token_ids)
         log.debug(
@@ -211,9 +387,11 @@ class KvRouter:
 
     def prefill_done(self, request_id: str) -> None:
         self.loads.prefill_done(request_id)
+        self._sync_emit("prefill_done", request_id)
 
     def free(self, request_id: str) -> None:
         self.loads.free(request_id)
+        self._sync_emit("free", request_id)
 
 
 class KvPushRouter(AsyncEngine):

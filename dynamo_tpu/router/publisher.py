@@ -95,11 +95,12 @@ class WorkerMetricsPublisher:
 
     def __init__(
         self, component: Component, worker_id: int, stats_fn,
-        interval_s: float = 1.0,
+        interval_s: float = 1.0, extra_fn=None,
     ):
         self.component = component
         self.worker_id = worker_id
         self.stats_fn = stats_fn      # () -> SchedulerStats
+        self.extra_fn = extra_fn      # () -> dict merged into the snapshot
         self.interval_s = interval_s
         self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
         self._task: Optional[asyncio.Task] = None
@@ -115,7 +116,7 @@ class WorkerMetricsPublisher:
 
     def snapshot(self) -> dict:
         s = self.stats_fn()
-        return {
+        snap = {
             "worker_id": self.worker_id,
             "num_requests_running": s.num_running,
             "num_requests_waiting": s.num_waiting,
@@ -124,6 +125,12 @@ class WorkerMetricsPublisher:
             "prefix_cache_hits": s.prefix_cache_hits,
             "prefix_cache_queries": s.prefix_cache_queries,
         }
+        if self.extra_fn is not None:
+            try:
+                snap.update(self.extra_fn())
+            except Exception:
+                log.exception("metrics extra_fn failed")
+        return snap
 
     async def _pump(self) -> None:
         store = self.component.runtime.store

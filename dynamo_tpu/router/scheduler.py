@@ -31,6 +31,15 @@ class KvRouterConfig:
     # workers above this fraction of busy decode blocks are rejected when
     # every candidate is saturated (ref: push_router.rs:58 busy threshold)
     busy_threshold: Optional[float] = None
+    # persist the prefix-index snapshot (behind a store lock) every N
+    # applied KV events so a router restart warm-starts instead of routing
+    # blind (ref: kv_router.rs:979 radix-bucket snapshots). 0 = disabled.
+    snapshot_threshold: int = 1000
+    # publish/apply routing add/prefill_done/free events between router
+    # replicas so peers see each other's in-flight load instead of
+    # double-booking workers (ref: kv_router.rs:65-73 prefill_events /
+    # active_sequences_events subjects)
+    replica_sync: bool = True
 
 
 def softmax_sample(

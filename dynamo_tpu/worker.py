@@ -69,6 +69,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--min-remote-prefill-tokens", type=int, default=32)
+    p.add_argument(
+        "--disagg-queue", action="store_true",
+        help="queue-based disagg: decode q_pushes prefill work onto the "
+             "store work queue, prefill workers q_pop (ref: the JetStream "
+             "prefill queue); default is direct round-robin push",
+    )
+    p.add_argument("--disagg-queue-name", default="prefill_queue")
     p.add_argument("--kvbm-host-blocks", type=int, default=0,
                    help="G2 host-tier capacity in blocks (0 = KVBM off)")
     p.add_argument("--kvbm-disk-dir", default=None)
@@ -188,14 +195,20 @@ async def run_worker(args: argparse.Namespace) -> None:
         ), remote=remote)
 
     handler = None
+    queue_worker = None
     component = args.component
     if args.disagg_mode == "prefill":
-        from .disagg import PrefillHandler
+        from .disagg import PrefillHandler, PrefillQueueWorker
 
         # prefill workers serve on their own component; decode workers own
         # model registration (ref: vllm main.py:137 init_prefill)
         component = args.prefill_component
         handler = PrefillHandler(engine)
+        if args.disagg_queue:
+            queue_worker = PrefillQueueWorker(
+                handler, runtime.store, queue_name=args.disagg_queue_name
+            )
+            queue_worker.start()
         tokenizer = None
     elif args.disagg_mode == "decode":
         from .disagg import DecodeHandler, DisaggConfig
@@ -207,8 +220,11 @@ async def run_worker(args: argparse.Namespace) -> None:
         handler = DecodeHandler(
             engine, prefill_client,
             DisaggConfig(
-                min_remote_prefill_tokens=args.min_remote_prefill_tokens
+                min_remote_prefill_tokens=args.min_remote_prefill_tokens,
+                use_queue=args.disagg_queue,
+                queue_name=args.disagg_queue_name,
             ),
+            store=runtime.store,
         )
 
     opts = ServeOptions(
@@ -221,6 +237,10 @@ async def run_worker(args: argparse.Namespace) -> None:
     served, kv_pub, metrics_pub = await serve_engine(
         runtime, engine, eng_cfg, opts, tokenizer, handler=handler
     )
+    if args.disagg_mode == "decode" and args.disagg_queue:
+        # surface the prefill backlog to the planner via load metrics
+        metrics_pub.extra_fn = handler.metrics_extra
+        handler.start_depth_monitor()
     if args.disagg_mode == "decode":
         inject_ep = (runtime.namespace().component(component)
                      .endpoint("kv_inject"))
@@ -235,6 +255,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         await run_until_shutdown(runtime, engine, served, kv_pub,
                                  metrics_pub)
     finally:
+        if queue_worker is not None:
+            await queue_worker.stop()
         if hasattr(handler, "close"):
             handler.close()
 

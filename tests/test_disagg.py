@@ -172,3 +172,109 @@ async def test_remote_prefill_failure_falls_back(disagg_pair):
     assert len(got) == 4
     assert decode_handler.num_local_prefills == 1
     assert not decode_handler.pending  # reservation cleaned up
+
+
+# ----------------------- queue-based disagg ----------------------------
+# (ref: the JetStream "Prefill Queue" in docs/architecture/disagg_serving.md;
+#  lib/runtime/src/transports/nats.rs:426 pull-queue semantics)
+
+
+@pytest.fixture
+async def queue_disagg_pair():
+    """Prefill + decode engines joined only by the store work queue."""
+    from dynamo_tpu.disagg.handlers import PrefillQueueWorker
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    store_server = StoreServer(host="127.0.0.1", port=0)
+    await store_server.start()
+    prefill_store = await StoreClient.connect(
+        f"127.0.0.1:{store_server.port}")
+    decode_store = await StoreClient.connect(
+        f"127.0.0.1:{store_server.port}")
+
+    prefill_engine = make_engine(seed=0)
+    decode_engine = make_engine(seed=0)
+    prefill_handler = PrefillHandler(prefill_engine)
+    queue_worker = PrefillQueueWorker(
+        prefill_handler, prefill_store, queue_name="test_prefill_q"
+    )
+    queue_worker.start()
+    decode_handler = DecodeHandler(
+        decode_engine,
+        prefill_client=None,
+        config=DisaggConfig(min_remote_prefill_tokens=8, use_queue=True,
+                            queue_name="test_prefill_q", queue_wait_s=30.0),
+        store=decode_store,
+    )
+    inject_server = IngressServer(decode_handler.inject_handler(),
+                                  host="127.0.0.1", port=0)
+    await inject_server.start()
+    decode_handler.kv_inject_addr = f"127.0.0.1:{inject_server.port}"
+
+    yield prefill_engine, decode_engine, decode_handler, queue_worker
+
+    await queue_worker.stop()
+    if hasattr(prefill_handler, "_transport"):
+        await prefill_handler._transport.close()
+    await inject_server.stop()
+    await prefill_engine.stop()
+    await decode_engine.stop()
+    await prefill_store.close()
+    await decode_store.close()
+    await store_server.stop()
+
+
+async def test_queue_disagg_matches_aggregated(queue_disagg_pair):
+    """Queue mode is token-exact vs aggregated serving, counts as a remote
+    prefill, and surfaces the backlog signal for the planner."""
+    prefill_engine, decode_engine, decode_handler, qw = queue_disagg_pair
+    prompt = list(range(1, 40))
+    request = {"token_ids": prompt, "max_tokens": 8, "ignore_eos": True}
+
+    local = make_engine(seed=0)
+    expected = await _collect(local.generate(dict(request), Context()))
+    await local.stop()
+
+    got = await _collect(decode_handler.generate(dict(request), Context()))
+    assert got == expected
+    assert decode_handler.num_remote_prefills == 1
+    assert decode_handler.num_local_prefills == 0
+    assert qw.num_pulled == 1
+    assert "prefill_queue_depth" in decode_handler.metrics_extra()
+    assert len(prefill_engine.scheduler.running) == 0
+
+
+async def test_queue_prefill_failure_reports_back(queue_disagg_pair):
+    """A failing queued prefill notifies decode through the inject endpoint
+    so the local fallback happens immediately, not at the wait deadline."""
+    import time
+
+    _, _, decode_handler, qw = queue_disagg_pair
+
+    async def exploding_execute(item, *, include_token):
+        raise RuntimeError("prefill worker exploded")
+
+    qw.handler.execute = exploding_execute
+    request = {"token_ids": list(range(1, 40)), "max_tokens": 4,
+               "ignore_eos": True}
+    t0 = time.monotonic()
+    got = await _collect(decode_handler.generate(dict(request), Context()))
+    elapsed = time.monotonic() - t0
+    assert len(got) == 4
+    assert decode_handler.num_local_prefills == 1
+    assert qw.num_failed == 1
+    assert elapsed < 10.0, "failure was not reported back promptly"
+    assert not decode_handler.pending
+
+
+async def test_queue_no_consumer_times_out_to_local(queue_disagg_pair):
+    """No prefill worker pulling → decode falls back after queue_wait_s."""
+    _, _, decode_handler, qw = queue_disagg_pair
+    await qw.stop()
+    decode_handler.config.queue_wait_s = 1.5
+    request = {"token_ids": list(range(1, 40)), "max_tokens": 4,
+               "ignore_eos": True}
+    got = await _collect(decode_handler.generate(dict(request), Context()))
+    assert len(got) == 4
+    assert decode_handler.num_local_prefills == 1
+    assert decode_handler.num_remote_prefills == 0

@@ -22,8 +22,11 @@ def tokenizer_file(tmp_path_factory):
     return str(path)
 
 
-@pytest.fixture
-def disagg_cluster(tokenizer_file):
+@pytest.fixture(params=["push", "queue"])
+def disagg_cluster(request, tokenizer_file):
+    """Both transfer-plane trigger modes: direct round-robin push and the
+    store work queue (ref: the JetStream prefill queue)."""
+    queue_mode = request.param == "queue"
     store_port = free_port()
     http_port = free_port()
     procs = []
@@ -40,14 +43,16 @@ def disagg_cluster(tokenizer_file):
               "--tokenizer", tokenizer_file, "--block-size", "4",
               "--num-blocks", "256", "--max-model-len", "512",
               "--max-batched-tokens", "512"]
+    queue_flags = ["--disagg-queue"] if queue_mode else []
     prefill = ManagedProcess(
-        ["-m", "dynamo_tpu.worker", *common, "--disagg-mode", "prefill"],
+        ["-m", "dynamo_tpu.worker", *common, "--disagg-mode", "prefill",
+         *queue_flags],
         name="prefill", env=env, ready_pattern=r"worker ready.*mode=prefill",
     )
     procs.append(prefill)
     decode = ManagedProcess(
         ["-m", "dynamo_tpu.worker", *common, "--disagg-mode", "decode",
-         "--min-remote-prefill-tokens", "16"],
+         "--min-remote-prefill-tokens", "16", *queue_flags],
         name="decode", env=env, ready_pattern=r"worker ready.*mode=decode",
     )
     procs.append(decode)

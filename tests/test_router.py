@@ -187,7 +187,7 @@ def test_busy_threshold_rejection():
     router.client = FakeClient()
     router.component = None
     router.block_size = BS
-    router.config = KvRouterConfig(busy_threshold=0.8)
+    router.config = KvRouterConfig(busy_threshold=0.8, replica_sync=False)
     router.indexer = KvIndexer(BS)
     router.approx = None
     router.loads = PotentialLoads(BS)
@@ -308,3 +308,93 @@ async def test_router_removed_worker_drops_index(two_worker_cluster):
     sel = router.find_best_match("q2", prompt)
     assert sel.worker_id == other_id
     router.free("q2")
+
+
+# --------------------- replica sync + snapshot ------------------------
+# (ref: kv_router.rs:65-73 inter-router sync; :979 radix-bucket snapshot)
+
+
+@pytest.mark.anyio
+async def test_replica_sync_no_double_booking(two_worker_cluster):
+    """A second router replica must see the first replica's in-flight load
+    and route the next (overlap-free) request to the other worker."""
+    c = two_worker_cluster
+    router_a: KvRouter = c["router"]
+    client = c["client"]
+
+    router_b = KvRouter(client, router_a.component, block_size=4, seed=0)
+    await router_b.start()
+    try:
+        prompt_a = list(range(1, 33))
+        sel_a = router_a.find_best_match("sync-a", prompt_a)
+
+        # router B learns A's booking via the sync subject
+        for _ in range(100):
+            if router_b.loads.decode_blocks(sel_a.worker_id) > 0:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            pytest.fail("peer routing event never reached replica B")
+
+        prompt_b = list(range(101, 133))  # no overlap with anything
+        sel_b = router_b.find_best_match("sync-b", prompt_b)
+        assert sel_b.worker_id != sel_a.worker_id, (
+            "replica B double-booked the worker replica A just loaded"
+        )
+
+        # freeing on A propagates to B
+        router_a.free("sync-a")
+        for _ in range(100):
+            if router_b.loads.decode_blocks(sel_a.worker_id) == 0:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            pytest.fail("peer free event never reached replica B")
+        router_b.free("sync-b")
+    finally:
+        await router_b.stop()
+
+
+@pytest.mark.anyio
+async def test_router_restart_keeps_prefix_affinity(two_worker_cluster):
+    """A freshly started router warm-starts its prefix index from the
+    persisted snapshot instead of routing blind."""
+    c = two_worker_cluster
+    router: KvRouter = c["router"]
+    router.config.snapshot_threshold = 1  # snapshot eagerly for the test
+    client = c["client"]
+    warm = c["workers"][0]
+    warm_id = warm["rt"].primary_lease
+    prompt = list(range(1, 33))
+
+    async for out in warm["engine"].submit(
+        Request(request_id="warm-snap", token_ids=prompt, max_tokens=4)
+    ):
+        pass
+    for _ in range(100):
+        if router.indexer.num_blocks(warm_id) >= 8:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        pytest.fail("kv events never reached the router indexer")
+
+    store = client.runtime.store
+    for _ in range(100):
+        if await store.get(router._snapshot_key()):
+            break
+        await asyncio.sleep(0.05)
+    else:
+        pytest.fail("index snapshot was never persisted")
+    await router.stop()
+
+    # a brand-new router (fresh process in production) starts warm
+    router2 = KvRouter(client, router.component, block_size=4, seed=0)
+    await router2.start()
+    try:
+        assert router2.indexer.num_blocks(warm_id) >= 8
+        sel = router2.find_best_match("after-restart", prompt + [99, 100])
+        assert sel.worker_id == warm_id
+        assert sel.overlap_blocks == 8
+        router2.free("after-restart")
+    finally:
+        await router2.stop()
