@@ -162,9 +162,15 @@ class ModelWatcher:
             if event["event"] == "dropped":
                 log.warning("model watch dropped — resubscribing")
                 await stream.cancel()
-                snapshot, stream = await self.runtime.store.watch_prefix(
-                    MODEL_ROOT
-                )
+                while True:  # outlast a store reconnect window
+                    try:
+                        snapshot, stream = (
+                            await self.runtime.store.watch_prefix(MODEL_ROOT)
+                        )
+                        break
+                    except Exception:
+                        log.exception("model rewatch failed — retrying")
+                        await asyncio.sleep(0.5)
                 live_keys = {k for k, _ in snapshot}
                 for name, keys in list(self._instances.items()):
                     for k in list(keys):

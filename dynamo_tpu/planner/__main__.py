@@ -84,7 +84,13 @@ async def run_planner(args: argparse.Namespace) -> None:
             if event is None or event["event"] == "dropped":
                 log.warning("frontend_stats subscription lost — resubscribing")
                 await sub.cancel()
-                sub = await runtime.store.subscribe(subject)
+                while True:  # outlast a store reconnect window
+                    try:
+                        sub = await runtime.store.subscribe(subject)
+                        break
+                    except Exception:
+                        log.exception("stats resubscribe failed — retrying")
+                        await asyncio.sleep(0.5)
                 continue
             if event["event"] != "msg":
                 continue

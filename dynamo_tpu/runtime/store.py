@@ -119,11 +119,24 @@ class _WorkQueue:
 
 
 class StoreServer:
-    """In-memory revisioned lease-KV store served over TCP."""
+    """In-memory revisioned lease-KV store served over TCP.
 
-    def __init__(self, host: str = "0.0.0.0", port: int = DEFAULT_PORT):
+    With ``persist_path`` set, unleased KV entries, work-queue items, and
+    the revision counter are snapshotted to disk (msgpack, atomic rename)
+    whenever dirty and restored on start — the durability role etcd's raft
+    log plays in the reference (ref: transports/etcd.rs). Leased keys are
+    deliberately NOT persisted: they are liveness claims whose owners must
+    re-assert them (clients re-put leased keys on reconnect, see
+    :class:`StoreClient`), exactly like etcd leases dying with the cluster.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = DEFAULT_PORT,
+                 persist_path: Optional[str] = None,
+                 persist_interval_s: float = 1.0):
         self.host = host
         self.port = port
+        self.persist_path = persist_path
+        self.persist_interval_s = persist_interval_s
         self._kv: Dict[str, _KvEntry] = {}
         self._leases: Dict[int, _Lease] = {}
         self._watches: Dict[int, _Watch] = {}
@@ -131,29 +144,105 @@ class StoreServer:
         self._queues: Dict[str, "_WorkQueue"] = {}
         self._locks: Dict[str, Tuple[int, int]] = {}  # name -> (lease_id, watch count)
         self._revision = 0
-        self._ids = itertools.count(1)
+        # time-seeded so a restarted store never re-issues watch/lease ids a
+        # client still holds from the previous incarnation (a stale
+        # WatchStream.cancel would otherwise unwatch a stranger's fresh id)
+        self._ids = itertools.count(int(time.time()) % (1 << 30) << 16)
         self._server: Optional[asyncio.AbstractServer] = None
         self._expiry_task: Optional[asyncio.Task] = None
+        self._persist_task: Optional[asyncio.Task] = None
+        self._dirty = False
         self._conn_writers: set = set()
 
     # -- lifecycle --
 
     async def start(self) -> None:
+        if self.persist_path:
+            self._restore()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.create_task(self._expire_loop())
+        if self.persist_path:
+            self._persist_task = asyncio.create_task(self._persist_loop())
         log.info("store listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
         if self._expiry_task:
             self._expiry_task.cancel()
+        if self._persist_task:
+            self._persist_task.cancel()
+            self._persist_task = None
+        if self.persist_path and self._dirty:
+            self._persist()
         for writer in list(self._conn_writers):
             writer.close()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+
+    # -- durability --
+
+    def _restore(self) -> None:
+        import os
+
+        if not os.path.exists(self.persist_path):
+            return
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=False)
+            # build into locals and assign atomically: a corrupt section
+            # must yield EMPTY state, not a half-restored one that the next
+            # persist would overwrite the good snapshot with
+            revision = int(snap.get("revision", 0))
+            kv = {
+                key: _KvEntry(value, 0, revision, revision)
+                for key, value in snap.get("kv", [])
+            }
+            queues: Dict[str, _WorkQueue] = {}
+            for name, items in snap.get("queues", {}).items():
+                q = _WorkQueue()
+                q.items.extend(bytes(i) for i in items)
+                queues[name] = q
+            self._revision = revision
+            self._kv = kv
+            self._queues = queues
+            log.info(
+                "restored %d keys, %d queues at revision %d from %s",
+                len(self._kv), len(self._queues), self._revision,
+                self.persist_path,
+            )
+        except Exception:
+            log.exception("store restore failed — starting empty")
+
+    def _persist(self) -> None:
+        import os
+        import tempfile
+
+        try:
+            snap = msgpack.packb({
+                "revision": self._revision,
+                # leased keys are liveness claims — never persisted
+                "kv": [[k, e.value] for k, e in sorted(self._kv.items())
+                       if e.lease_id == 0],
+                "queues": {name: q.items
+                           for name, q in self._queues.items() if q.items},
+            })
+            d = os.path.dirname(os.path.abspath(self.persist_path))
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(snap)
+            os.replace(tmp, self.persist_path)
+            self._dirty = False
+        except Exception:
+            log.exception("store persist failed")
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.persist_interval_s)
+            if self._dirty:
+                self._persist()
 
     async def serve_forever(self) -> None:
         await self.start()
@@ -255,6 +344,10 @@ class StoreServer:
         self._kv[key] = _KvEntry(value, lease_id, create_rev, self._revision)
         if lease is not None:
             lease.keys.add(key)
+        # dirty when the persisted set changes: an unleased write, or a
+        # leased write shadowing a previously-persisted unleased key
+        if lease_id == 0 or (prev is not None and prev.lease_id == 0):
+            self._dirty = True
         self._notify("put", key, value, self._revision)
         return self._revision
 
@@ -267,6 +360,8 @@ class StoreServer:
             lease = self._leases.get(entry.lease_id)
             if lease:
                 lease.keys.discard(key)
+        else:
+            self._dirty = True
         self._notify("delete", key, None, self._revision)
         return True
 
@@ -427,11 +522,13 @@ class StoreServer:
             if op == "q_push":
                 q = self._queues.setdefault(msg["queue"], _WorkQueue())
                 depth = q.push(msg["payload"])
+                self._dirty = True
                 return {"seq": seq, "ok": True, "depth": depth}
             if op == "q_pop":
                 q = self._queues.setdefault(msg["queue"], _WorkQueue())
                 item = q.pop_nowait()
                 if item is not None:
+                    self._dirty = True
                     return {"seq": seq, "ok": True, "payload": item}
                 self._q_pop_async(q, msg, writer)
                 return None  # response written when an item arrives / timeout
@@ -461,6 +558,7 @@ class StoreServer:
                 payload = None
             else:
                 payload = f.result()
+                self._dirty = True
             if writer.is_closing():
                 if payload is not None:
                     q.push(payload)
@@ -523,6 +621,15 @@ class StoreClient:
         self.primary_lease: int = 0
         self.on_lease_lost: Optional[Callable[[], None]] = None
         self._closed = False
+        self._lease_ttl_s: float = 10.0
+        # keys this client holds under its primary lease, re-asserted after
+        # a reconnect (a restarted store forgot them; the reference's etcd
+        # survives via raft — here the client replays its own claims)
+        self._leased_keys: Dict[str, bytes] = {}
+        self._recover_task: Optional[asyncio.Task] = None
+        # how long reconnect attempts may run before declaring lease loss
+        self.recover_timeout_s: float = 30.0
+        self.num_recoveries = 0
 
     @staticmethod
     async def connect(
@@ -541,6 +648,7 @@ class StoreClient:
                 await asyncio.sleep(retry_delay_s)
         else:
             raise StoreError(f"cannot connect to store at {addr}: {last}")
+        client._lease_ttl_s = lease_ttl_s
         client.primary_lease = await client.lease_grant(lease_ttl_s)
         client._keepalive_task = asyncio.create_task(
             client._keepalive_loop(lease_ttl_s)
@@ -576,12 +684,22 @@ class StoreClient:
         while True:
             msg = await read_frame(self._reader)
             if msg is None:
-                for fut in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(StoreError("store connection closed"))
-                self._pending.clear()
-                for q in self._watch_queues.values():
-                    q.put_nowait(None)
+                # mark the connection dead FIRST so a racing _call() raises
+                # instead of registering a future nothing will ever resolve
+                if self._writer is not None:
+                    self._writer.close()
+                self._fail_pending()
+                # watchers see "dropped" (not a silent end): consumers
+                # resubscribe on dropped, retrying through the reconnect
+                # window
+                for wid, q in list(self._watch_queues.items()):
+                    q.put_nowait(
+                        None if self._closed
+                        else {"watch_id": wid, "event": "dropped",
+                              "key": "", "value": None, "rev": 0}
+                    )
+                if not self._closed:
+                    self._start_recovery()
                 return
             seq = msg.get("seq")
             if seq is None:
@@ -635,12 +753,83 @@ class StoreClient:
             except Exception:
                 if self._closed:
                     return
-                log.error("primary lease keepalive failed — signalling lease loss")
-                if self.on_lease_lost:
-                    self.on_lease_lost()
+                # lease unknown / connection gone: try recovery (a restarted
+                # store grants a fresh lease and we re-assert our keys)
+                # before declaring the worker dead
+                log.warning("primary lease keepalive failed — recovering")
+                self._start_recovery()
                 return
 
+    # -- reconnect / lease recovery --
+
+    def _fail_pending(self) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(StoreError("store connection closed"))
+        self._pending.clear()
+
+    def _start_recovery(self) -> None:
+        if self._closed or self._recover_task is not None:
+            return
+        self._recover_task = asyncio.create_task(self._recover())
+
+    async def _recover(self) -> None:
+        """Reconnect, re-grant the primary lease, re-assert leased keys.
+
+        Key identity is preserved: instance records carry their original
+        instance_id in the VALUE, so watchers see the same worker come back
+        (a put on the same key), not a new one. Gives up after
+        ``recover_timeout_s`` and fires ``on_lease_lost``.
+        """
+        deadline = time.monotonic() + self.recover_timeout_s
+        try:
+            while not self._closed:
+                try:
+                    if self._keepalive_task:
+                        self._keepalive_task.cancel()
+                        self._keepalive_task = None
+                    if self._reader_task:
+                        self._reader_task.cancel()
+                    if self._writer is not None:
+                        self._writer.close()
+                    # in-flight RPCs from the keepalive-triggered path (the
+                    # reader may still have been alive) must fail, not hang
+                    self._fail_pending()
+                    await self._open()
+                    self.primary_lease = await self.lease_grant(
+                        self._lease_ttl_s
+                    )
+                    for key, value in list(self._leased_keys.items()):
+                        await self.put(key, value, lease=self.primary_lease)
+                    self._keepalive_task = asyncio.create_task(
+                        self._keepalive_loop(self._lease_ttl_s)
+                    )
+                    self.num_recoveries += 1
+                    log.info(
+                        "store connection recovered (lease %d, %d keys "
+                        "re-asserted)", self.primary_lease,
+                        len(self._leased_keys),
+                    )
+                    return
+                except Exception as exc:
+                    if time.monotonic() > deadline:
+                        log.error(
+                            "store recovery failed for %.0fs (%s) — "
+                            "signalling lease loss",
+                            self.recover_timeout_s, exc,
+                        )
+                        if self.on_lease_lost:
+                            self.on_lease_lost()
+                        return
+                    await asyncio.sleep(0.5)
+        finally:
+            self._recover_task = None
+
     # -- public kv api --
+
+    def _track_leased(self, key: str, value: bytes, lease: int) -> None:
+        if lease and lease == self.primary_lease:
+            self._leased_keys[key] = value
 
     async def put(self, key: str, value: bytes, lease: int = 0) -> int:
         resp = await self._call(
@@ -648,6 +837,7 @@ class StoreClient:
         )
         if not resp["ok"]:
             raise StoreError(resp.get("error", "put failed"))
+        self._track_leased(key, value, lease)
         return resp["rev"]
 
     async def create(self, key: str, value: bytes, lease: int = 0) -> bool:
@@ -655,6 +845,8 @@ class StoreClient:
         resp = await self._call(
             {"op": "create", "key": key, "value": value, "lease": lease}
         )
+        if resp["ok"]:
+            self._track_leased(key, value, lease)
         return bool(resp["ok"])
 
     async def cas(
@@ -664,6 +856,8 @@ class StoreClient:
             {"op": "cas", "key": key, "expect": expect, "value": value,
              "lease": lease}
         )
+        if resp["ok"]:
+            self._track_leased(key, value, lease)
         return bool(resp["ok"])
 
     async def get(self, key: str) -> Optional[bytes]:
@@ -677,10 +871,13 @@ class StoreClient:
 
     async def delete(self, key: str) -> bool:
         resp = await self._call({"op": "delete", "key": key})
+        self._leased_keys.pop(key, None)
         return bool(resp.get("deleted"))
 
     async def delete_prefix(self, prefix: str) -> int:
         resp = await self._call({"op": "delete_prefix", "prefix": prefix})
+        for key in [k for k in self._leased_keys if k.startswith(prefix)]:
+            del self._leased_keys[key]
         return int(resp.get("deleted", 0))
 
     async def lease_grant(self, ttl_s: float) -> int:
@@ -834,8 +1031,13 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="dynamo-tpu discovery store")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument(
+        "--persist", default=None, metavar="PATH",
+        help="snapshot unleased KV + work queues to PATH (msgpack, atomic "
+             "rename) and restore from it on start",
+    )
     args = parser.parse_args()
-    server = StoreServer(args.host, args.port)
+    server = StoreServer(args.host, args.port, persist_path=args.persist)
     asyncio.run(server.serve_forever())
 
 

@@ -1,0 +1,166 @@
+"""Store durability + client recovery: snapshot/restore of unleased KV and
+work queues, client reconnect re-asserting leased keys, and a full serving
+cluster surviving kill -9 of the store (the durability role of
+ref: lib/runtime/src/transports/etcd.rs raft persistence)."""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from test_llm_pipeline import byte_tokenizer  # noqa: E402
+from utils import ManagedProcess, free_port  # noqa: E402
+
+from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_persist_restores_unleased_kv_and_queues(tmp_path):
+    path = str(tmp_path / "store.snap")
+    s1 = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s1.start()
+    c = await StoreClient.connect(f"127.0.0.1:{s1.port}")
+    await c.put(b"durable/a".decode(), b"v1")
+    await c.put("durable/b", b"v2")
+    await c.put("ephemeral/lease", b"x", lease=c.primary_lease)
+    await c.q_push("jobs", b"job1")
+    await c.q_push("jobs", b"job2")
+    await c.close()
+    await s1.stop()  # final persist happens here
+
+    s2 = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s2.start()
+    c2 = await StoreClient.connect(f"127.0.0.1:{s2.port}")
+    assert await c2.get("durable/a") == b"v1"
+    assert await c2.get("durable/b") == b"v2"
+    # leased keys are liveness claims — never restored
+    assert await c2.get("ephemeral/lease") is None
+    assert await c2.q_len("jobs") == 2
+    assert await c2.q_pop("jobs", timeout_s=2) == b"job1"
+    await c2.close()
+    await s2.stop()
+
+
+async def test_client_recovers_and_reasserts_leased_keys(tmp_path):
+    """Store restarts on the same port → clients reconnect, re-grant their
+    lease, re-put their registrations; watchers resynchronise via the
+    dropped-event path."""
+    path = str(tmp_path / "store.snap")
+    port = free_port()
+    s1 = StoreServer("127.0.0.1", port, persist_path=path)
+    await s1.start()
+
+    worker = await StoreClient.connect(f"127.0.0.1:{port}")
+    await worker.put("v1/instances/ns/c/e/7", b"worker-record",
+                     lease=worker.primary_lease)
+    watcher = await StoreClient.connect(f"127.0.0.1:{port}")
+    snapshot, stream = await watcher.watch_prefix("v1/instances/")
+    assert len(snapshot) == 1
+
+    await s1.stop()  # store dies (connections drop)
+
+    # the watcher learns its watch is gone, not just silence
+    ev = await asyncio.wait_for(stream.next(), timeout=5)
+    assert ev is None or ev["event"] == "dropped"
+
+    s2 = StoreServer("127.0.0.1", port, persist_path=path)
+    await s2.start()
+
+    for _ in range(100):
+        if worker.num_recoveries >= 1 and watcher.num_recoveries >= 1:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        pytest.fail("clients never recovered")
+
+    # worker re-asserted its registration under a fresh lease
+    got = await watcher.get("v1/instances/ns/c/e/7")
+    assert got == b"worker-record"
+    # watcher can re-watch and sees the re-asserted state
+    snapshot2, stream2 = await watcher.watch_prefix("v1/instances/")
+    assert [k for k, _ in snapshot2] == ["v1/instances/ns/c/e/7"]
+    await stream2.cancel()
+    await worker.close()
+    await watcher.close()
+    await s2.stop()
+
+
+@pytest.fixture(scope="module")
+def tokenizer_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    path.write_text(byte_tokenizer().to_json_str())
+    return str(path)
+
+
+async def test_cluster_survives_store_kill9(tokenizer_file, tmp_path):
+    """kill -9 the store mid-serving; restart it; the worker and frontend
+    recover WITHOUT being restarted and serve the next request."""
+    store_port = free_port()
+    http_port = free_port()
+    snap = str(tmp_path / "store.snap")
+    procs = []
+
+    def start_store():
+        p = ManagedProcess(
+            ["-m", "dynamo_tpu.runtime.store", "--host", "127.0.0.1",
+             "--port", str(store_port), "--persist", snap],
+            name="store", ready_pattern=r"listening",
+        )
+        p.wait_ready(20)
+        return p
+
+    store = start_store()
+    procs.append(store)
+    env = {"DYNTPU_STORE_ADDR": f"127.0.0.1:{store_port}"}
+    worker = ManagedProcess(
+        ["-m", "dynamo_tpu.worker", "--model", "tiny",
+         "--model-name", "tiny-chat", "--tokenizer", tokenizer_file,
+         "--block-size", "4", "--num-blocks", "128",
+         "--max-model-len", "256", "--max-batched-tokens", "256"],
+        name="worker", env=env, ready_pattern=r"worker ready",
+    )
+    procs.append(worker)
+    worker.wait_ready(90)
+    frontend = ManagedProcess(
+        ["-m", "dynamo_tpu.frontend", "--host", "127.0.0.1",
+         "--port", str(http_port)],
+        name="frontend", env=env, ready_pattern=r"frontend ready",
+    )
+    procs.append(frontend)
+    frontend.wait_ready(30)
+
+    body = {"model": "tiny-chat", "max_tokens": 4,
+            "messages": [{"role": "user", "content": "hello there"}]}
+    url = f"http://127.0.0.1:{http_port}/v1/chat/completions"
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=body,
+                              timeout=aiohttp.ClientTimeout(total=120)) as r:
+                assert r.status == 200, await r.text()
+
+        store.kill()  # SIGKILL — no graceful anything
+        await asyncio.sleep(1.0)
+        store2 = start_store()
+        procs.append(store2)
+
+        # both the worker's and the frontend's store clients must recover
+        worker.wait_log(r"store connection recovered", 40)
+        frontend.wait_log(r"store connection recovered", 40)
+        # give discovery a moment to resettle the model watcher
+        await asyncio.sleep(1.0)
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=body,
+                              timeout=aiohttp.ClientTimeout(total=120)) as r:
+                assert r.status == 200, await r.text()
+    finally:
+        for p in reversed(procs):
+            try:
+                p.terminate()
+            except Exception:
+                pass
