@@ -510,6 +510,7 @@ class InferenceEngine(EngineCore):
                 self.mesh,
             ), donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(seed + 1)
+        self._encode_fn = None  # built lazily on the first embed()
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
         )
@@ -580,6 +581,60 @@ class InferenceEngine(EngineCore):
     async def inject_kv(self, seq, data: Dict[str, np.ndarray]) -> None:
         """Scatter received KV into a reserved sequence's blocks."""
         await self.inject_kv_blocks(seq.block_table, data)
+
+    # ----------------------- embeddings (encode) -----------------------
+
+    async def embed(self, token_ids_batch: List[List[int]]) -> List[List[float]]:
+        """Encode-only step for ``/v1/embeddings``: mean-pooled, normalised
+        final hidden states. Runs on the step executor thread (serialised
+        with generation steps). Inputs are bucketed to powers of two so XLA
+        compiles O(log T) encode programs."""
+        if self._encode_fn is None:
+            self._encode_fn = model_lib.make_encode_fn(self.model_config)
+        loop = asyncio.get_running_loop()
+
+        for ids in token_ids_batch:
+            if not ids:
+                raise ValueError("empty embedding input")
+            if len(ids) >= self.config.max_model_len:
+                raise ValueError(
+                    f"embedding input length {len(ids)} exceeds "
+                    f"max_model_len {self.config.max_model_len}"
+                )
+
+        def _run() -> List[List[float]]:
+            # group same-T-bucket inputs into one batched forward + one
+            # device_get (the (B, T) buckets are both pow2, so compile
+            # count stays O(log B * log T)); the step-executor thread is
+            # shared with generation, so fewer dispatches = less decode
+            # stall
+            out: List[Optional[List[float]]] = [None] * len(token_ids_batch)
+            groups: Dict[int, List[int]] = {}
+            for i, ids in enumerate(token_ids_batch):
+                groups.setdefault(_pow2_bucket(len(ids)), []).append(i)
+            for T, idxs in groups.items():
+                B = _pow2_bucket(len(idxs))
+                tokens = np.zeros((B, T), np.int32)
+                positions = np.full((B, T), -1, np.int32)
+                for row, i in enumerate(idxs):
+                    ids = token_ids_batch[i]
+                    tokens[row, :len(ids)] = ids
+                    positions[row, :len(ids)] = np.arange(len(ids))
+                vecs = np.asarray(jax.device_get(
+                    self._encode_fn(self.params, tokens, positions)
+                ))
+                for row, i in enumerate(idxs):
+                    out[i] = vecs[row].tolist()
+            return out  # type: ignore[return-value]
+
+        return await loop.run_in_executor(self._executor, _run)
+
+    async def embed_endpoint(self, request: Any, context: Context):
+        """Wire adapter for the worker's ``embed`` endpoint."""
+        vectors = await self.embed(
+            [list(ids) for ids in request["token_ids_batch"]]
+        )
+        yield {"embeddings": vectors}
 
     def attach_kvbm(self, config=None, remote=None):
         """Enable the multi-tier block manager on this engine (optionally

@@ -409,6 +409,70 @@ def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
     )
 
 
+# --------------------------- encode (embeddings) --------------------------
+
+
+def encode_forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,      # [B, T] int32 (0 = pad)
+    positions: jax.Array,   # [B, T] int32, -1 = pad
+) -> jax.Array:
+    """Encode-only forward: dense causal attention over the chunk, no paged
+    cache — the engine step for ``/v1/embeddings`` (ref: the embeddings
+    route in lib/llm/src/http/service/openai.rs:714; the reference delegates
+    to an embedding engine, here the decoder itself encodes).
+
+    Returns L2-normalised mean-pooled final hidden states ``[B, D]`` (mean
+    over non-pad positions — the standard decoder-as-encoder pooling).
+    """
+    B, T = tokens.shape
+    hd = cfg.head_dim_
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+
+    h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    stacked = params["layers"]
+    for li in range(cfg.num_layers):
+        p = {name: w[li] for name, w in stacked.items()}
+        x = _rms_norm(h, p["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ p["wq"]).reshape(B, T, H, hd)
+        k = (x @ p["wk"]).reshape(B, T, KV, hd)
+        v = (x @ p["wv"]).reshape(B, T, KV, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        attn = _attention(q, k, v, positions)
+        h = h + attn.reshape(B, T, H * hd) @ p["wo"]
+        x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            from ..parallel.moe import moe_ffn
+
+            D = x.shape[-1]
+            out = moe_ffn(
+                x.reshape(B * T, D),
+                p["w_router"], p["w_gate"], p["w_up"], p["w_down"],
+                top_k=cfg.num_experts_per_token,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+            h = h + out.reshape(B, T, D)
+        else:
+            gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
+            up = (x @ p["w_up"]).astype(jnp.float32)
+            h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+    h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+
+    valid = (positions >= 0).astype(jnp.float32)[:, :, None]  # [B, T, 1]
+    pooled = jnp.sum(h.astype(jnp.float32) * valid, axis=1) / jnp.maximum(
+        jnp.sum(valid, axis=1), 1.0
+    )                                                          # [B, D]
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-12)
+
+
+def make_encode_fn(cfg: ModelConfig):
+    """Jitted encode step: (params, tokens[B,T], positions[B,T]) -> [B, D]."""
+    return jax.jit(functools.partial(encode_forward, cfg))
+
+
 # ----------------------------- sampling ----------------------------------
 
 

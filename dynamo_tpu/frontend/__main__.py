@@ -15,7 +15,9 @@ import asyncio
 import signal
 
 from ..llm.discovery import ModelDeploymentCard, ModelWatcher
-from ..llm.entrypoint import build_routed_pipeline, make_kv_sink
+from ..llm.entrypoint import (
+    EmbeddingsPipeline, build_routed_pipeline, make_kv_sink,
+)
 from ..runtime.component import DistributedRuntime
 from ..utils.config import RuntimeConfig
 from ..utils.logging import get_logger
@@ -90,12 +92,24 @@ async def run_frontend(args: argparse.Namespace) -> None:
         engine = build_routed_pipeline(
             card, client, router_mode=args.router_mode, sink=sink,
         )
+        # embeddings ride the worker's encode-only "embed" endpoint; the
+        # card advertises the capability (mocker-backed models don't have
+        # it and their requests 400 immediately)
+        embed_engine = None
+        if "embeddings" in card.model_type:
+            embed_client = await (
+                runtime.namespace(entry["namespace"])
+                .component(entry["component"]).endpoint("embed").client()
+            )
+            clients[card.name + "/embed"] = embed_client
+            embed_engine = EmbeddingsPipeline(card, embed_client)
         manager.register(ModelEntry(
             name=card.name, engine=engine,
             chat="chat" in card.model_type,
             completions="completions" in card.model_type,
             tool_call_parser=card.tool_call_parser,
             reasoning_parser=card.reasoning_parser,
+            embed_engine=embed_engine,
         ))
 
     async def on_remove(name: str) -> None:
@@ -109,6 +123,9 @@ async def run_frontend(args: argparse.Namespace) -> None:
         client = clients.pop(name, None)
         if client:
             await client.stop()
+        embed_client = clients.pop(name + "/embed", None)
+        if embed_client:
+            await embed_client.stop()
 
     watcher = ModelWatcher(runtime, on_add, on_remove)
     await watcher.start()

@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional
 
 from aiohttp import web
 
@@ -41,6 +41,9 @@ class ModelEntry:
     metadata: dict = field(default_factory=dict)
     tool_call_parser: Optional[str] = None
     reasoning_parser: Optional[str] = None
+    # embeddings pipeline (llm.entrypoint.EmbeddingsPipeline); None when the
+    # backing engine has no encode path (e.g. mocker)
+    embed_engine: Optional[Any] = None
 
     def make_parser(self):
         """Fresh per-request stream parser pipeline (or None)."""
@@ -153,6 +156,8 @@ class HttpService:
         app.add_routes([
             web.post("/v1/chat/completions", self._chat),
             web.post("/v1/completions", self._completions),
+            web.post("/v1/embeddings", self._embeddings),
+            web.post("/v1/responses", self._responses),
             web.get("/v1/models", self._models),
             web.get("/health", self._health),
             web.get("/live", self._live),
@@ -206,6 +211,153 @@ class HttpService:
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, kind="completion")
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings — encode-only engine step
+        (ref: openai.rs:714 embeddings route)."""
+        endpoint = "/v1/embeddings"
+        try:
+            body = await request.json()
+        except Exception:
+            return self._err(400, "invalid JSON body", "na", endpoint)
+        model = body.get("model", "")
+        inputs = body.get("input")
+        if inputs is None or inputs == "" or inputs == []:
+            return self._err(400, "missing 'input'", model, endpoint)
+        entry = self.manager.get(model)
+        if entry is None:
+            return self._err(404, f"model {model!r} not found", model,
+                             endpoint)
+        if entry.embed_engine is None:
+            return self._err(
+                400, f"model {model!r} does not support embeddings",
+                model, endpoint,
+            )
+        self._m_inflight.labels(model=model).inc()
+        t0 = time.monotonic()
+        try:
+            vectors, prompt_tokens = await entry.embed_engine.embed(inputs)
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="200"
+            ).inc()
+            return web.json_response({
+                "object": "list",
+                "model": model,
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": v}
+                    for i, v in enumerate(vectors)
+                ],
+                "usage": {"prompt_tokens": prompt_tokens,
+                          "total_tokens": prompt_tokens},
+            })
+        except EngineError as e:
+            code = 503 if e.code in ("unavailable", "overloaded") else 500
+            return self._err(code, str(e), model, endpoint)
+        except ValueError as e:
+            return self._err(400, str(e), model, endpoint)
+        except Exception:
+            log.exception("embeddings request failed")
+            return self._err(500, "internal error", model, endpoint)
+        finally:
+            self._m_inflight.labels(model=model).dec()
+            self._m_duration.labels(model=model).observe(
+                time.monotonic() - t0
+            )
+
+    async def _responses(self, request: web.Request) -> web.StreamResponse:
+        """/v1/responses — the OpenAI Responses surface over the chat
+        pipeline (ref: openai.rs:714)."""
+        endpoint = "/v1/responses"
+        try:
+            body = await request.json()
+        except Exception:
+            return self._err(400, "invalid JSON body", "na", endpoint)
+        model = body.get("model", "")
+        try:
+            chat_body = oai.responses_to_chat(body)
+        except oai.RequestError as e:
+            return self._err(400, str(e), model, endpoint)
+        entry = self.manager.get(model)
+        if entry is None:
+            return self._err(404, f"model {model!r} not found", model,
+                             endpoint)
+        if not entry.chat:
+            return self._err(400, f"model {model!r} does not support chat",
+                             model, endpoint)
+        ctx = Context()
+        rid = oai.response_id()
+        stream_mode = bool(body.get("stream", False))
+        self._m_inflight.labels(model=model).inc()
+        t0 = time.monotonic()
+        try:
+            outputs = entry.engine.generate(chat_body, ctx)
+            outputs = self._observe(outputs, model, t0)
+            chunks = oai.chat_stream(
+                outputs, rid, model, parser=entry.make_parser()
+            )
+            if stream_mode:
+                return await self._sse_events(
+                    request, oai.responses_stream(chunks, rid, model),
+                    ctx, model, endpoint,
+                )
+            agg = await oai.aggregate_chat(chunks)
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="200"
+            ).inc()
+            return web.json_response(oai.chat_to_response(agg, rid, model))
+        except EngineError as e:
+            code = 503 if e.code in ("unavailable", "overloaded") else 500
+            return self._err(code, str(e), model, endpoint)
+        except ValueError as e:
+            return self._err(400, str(e), model, endpoint)
+        except asyncio.CancelledError:
+            ctx.kill()
+            raise
+        except Exception:
+            log.exception("request %s failed", rid)
+            return self._err(500, "internal error", model, endpoint)
+        finally:
+            self._m_inflight.labels(model=model).dec()
+            self._m_duration.labels(model=model).observe(
+                time.monotonic() - t0
+            )
+
+    async def _sse_events(
+        self, request: web.Request, events, ctx: Context, model: str,
+        endpoint: str,
+    ) -> web.StreamResponse:
+        """SSE writer for typed (event, payload) streams (Responses API)."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "Connection": "keep-alive"},
+        )
+        await resp.prepare(request)
+        try:
+            async for event, payload in events:
+                await resp.write(oai.sse_event(event, payload).encode())
+            # no chat-style [DONE] frame: the Responses protocol ends at
+            # the typed response.completed event
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="200"
+            ).inc()
+        except (ConnectionResetError, asyncio.CancelledError):
+            log.info("client disconnected — killing request")
+            ctx.kill()
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="499"
+            ).inc()
+        except EngineError as e:
+            await resp.write(oai.sse_event(
+                "error", {"error": {"message": str(e), "code": e.code}}
+            ).encode())
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="503"
+            ).inc()
+        with _suppress():
+            await resp.write_eof()
+        return resp
 
     # ------------------------ request flow ------------------------------
 

@@ -59,6 +59,58 @@ def build_routed_pipeline(
     return link(pre, back, Migration(inner, card.migration_limit))
 
 
+class EmbeddingsPipeline:
+    """Tokenise → worker ``embed`` endpoint → vectors
+    (ref: the embeddings path of openai.rs:714; tokenisation mirrors the
+    generation preprocessor, pooling happens on-device in the engine)."""
+
+    def __init__(self, card: ModelDeploymentCard, client: Client,
+                 tokenizer=None):
+        # accept a shared tokenizer — loading twice per model registration
+        # (once here, once in build_routed_pipeline) doubles add latency
+        self.tokenizer = tokenizer or card.load_tokenizer()
+        self.client = client
+        self.max_context_len = card.context_length
+
+    async def embed(self, inputs) -> tuple:
+        """inputs: str | [str] | [int] | [[int]] → (vectors, prompt_tokens).
+        Raises ValueError (→ HTTP 400) on any other shape."""
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        elif isinstance(inputs, list):
+            if inputs and all(type(i) is int for i in inputs):
+                inputs = [inputs]
+        else:
+            raise ValueError(
+                "input must be a string, a list of strings, or token arrays"
+            )
+        batch = []
+        for item in inputs:
+            if isinstance(item, str):
+                ids = self.tokenizer.encode(item)
+            elif (isinstance(item, list)
+                  and all(type(i) is int for i in item)):
+                ids = list(item)
+            else:
+                raise ValueError(
+                    "each input must be a string or an array of token ids"
+                )
+            if not ids:
+                raise ValueError("empty embedding input")
+            if len(ids) >= self.max_context_len:
+                raise ValueError(
+                    f"input of {len(ids)} tokens exceeds the "
+                    f"{self.max_context_len}-token context"
+                )
+            batch.append(ids)
+        prompt_tokens = sum(len(ids) for ids in batch)
+        async for out in self.client.round_robin(
+            {"token_ids_batch": batch}, Context()
+        ):
+            return out["embeddings"], prompt_tokens
+        raise RuntimeError("embed endpoint returned no response")
+
+
 def build_local_pipeline(
     engine: AsyncEngine,
     tokenizer,

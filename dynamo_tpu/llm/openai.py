@@ -298,11 +298,141 @@ async def aggregate_completion(chunks: AsyncIterator[dict]) -> dict:
     }
 
 
+# --------------------------- responses API ---------------------------------
+# (ref: the /v1/responses route in lib/llm/src/http/service/openai.rs:714 —
+#  the OpenAI Responses surface mapped onto the chat pipeline)
+
+
+def response_id() -> str:
+    return f"resp-{uuid.uuid4().hex}"
+
+
+def _responses_input_to_messages(inp, instructions=None) -> List[dict]:
+    """Responses ``input`` (string | message list) → chat messages."""
+    messages: List[dict] = []
+    if instructions:
+        messages.append({"role": "system", "content": str(instructions)})
+    if isinstance(inp, str):
+        messages.append({"role": "user", "content": inp})
+        return messages
+    if not isinstance(inp, list):
+        raise RequestError("input must be a string or a list of messages")
+    for item in inp:
+        if not isinstance(item, dict):
+            raise RequestError("input items must be message objects")
+        role = item.get("role", "user")
+        content = item.get("content", "")
+        if isinstance(content, list):
+            # content parts: keep the text parts
+            content = "".join(
+                p.get("text", "") for p in content
+                if isinstance(p, dict)
+                and p.get("type") in ("input_text", "output_text", "text")
+            )
+        messages.append({"role": role, "content": content})
+    return messages
+
+
+def responses_to_chat(req: dict) -> dict:
+    """Translate a /v1/responses body into the chat-pipeline request."""
+    if "input" not in req:
+        raise RequestError("missing 'input'")
+    body: dict = {
+        "model": req.get("model", ""),
+        "messages": _responses_input_to_messages(
+            req["input"], req.get("instructions")
+        ),
+    }
+    if req.get("max_output_tokens") is not None:
+        body["max_tokens"] = req["max_output_tokens"]
+    for key in ("temperature", "top_p", "seed", "stop"):
+        if req.get(key) is not None:
+            body[key] = req[key]
+    _validate_sampling(body)
+    return body
+
+
+def response_object(
+    rid: str, model: str, text: str, usage: Optional[dict],
+    status: str = "completed",
+) -> dict:
+    usage = usage or usage_dict(0, 0)
+    return {
+        "id": rid,
+        "object": "response",
+        "created_at": int(time.time()),
+        "status": status,
+        "model": model,
+        "output": [{
+            "type": "message",
+            "id": f"{rid}-msg0",
+            "status": status,
+            "role": "assistant",
+            "content": [{"type": "output_text", "text": text,
+                         "annotations": []}],
+        }],
+        "usage": {
+            "input_tokens": usage.get("prompt_tokens", 0),
+            "output_tokens": usage.get("completion_tokens", 0),
+            "total_tokens": usage.get("total_tokens", 0),
+        },
+    }
+
+
+def chat_to_response(agg: dict, rid: str, model: str) -> dict:
+    """Aggregated chat.completion → Responses object."""
+    choice = agg["choices"][0]
+    finish = choice.get("finish_reason")
+    return response_object(
+        rid, model, choice["message"].get("content") or "",
+        agg.get("usage"),
+        status="completed" if finish in ("stop", "tool_calls", "length")
+        else "incomplete",
+    )
+
+
+async def responses_stream(
+    chunks: AsyncIterator[dict], rid: str, model: str
+) -> AsyncIterator[tuple]:
+    """chat.completion.chunk stream → (event_type, payload) Responses SSE
+    events: response.created → response.output_text.delta* →
+    response.completed."""
+    yield "response.created", {
+        "type": "response.created",
+        "response": {"id": rid, "object": "response",
+                     "status": "in_progress", "model": model},
+    }
+    parts: List[str] = []
+    usage = None
+    async for c in chunks:
+        delta = c["choices"][0].get("delta", {})
+        if c.get("usage"):
+            usage = c["usage"]
+        text = delta.get("content")
+        if text:
+            parts.append(text)
+            yield "response.output_text.delta", {
+                "type": "response.output_text.delta",
+                "item_id": f"{rid}-msg0",
+                "output_index": 0,
+                "delta": text,
+            }
+    yield "response.completed", {
+        "type": "response.completed",
+        "response": response_object(rid, model, "".join(parts), usage),
+    }
+
+
 # ------------------------------- SSE ---------------------------------------
 
 
 def sse_frame(payload: dict) -> str:
     return f"data: {json.dumps(payload, separators=(',', ':'))}\n\n"
+
+
+def sse_event(event: str, payload: dict) -> str:
+    return (f"event: {event}\n"
+            f"data: {json.dumps(payload, separators=(',', ':'))}\n\n")
 
 
 def models_response(models: List[dict]) -> dict:

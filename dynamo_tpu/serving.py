@@ -73,6 +73,16 @@ async def serve_engine(
         clear_kv, advertise_host=opts.advertise_host
     )
 
+    # encode-only embeddings endpoint (device engines only — the mocker has
+    # no hidden states; ref: the embeddings route openai.rs:714)
+    supports_embeddings = hasattr(engine, "embed_endpoint")
+    if supports_embeddings:
+        embed_ep = (runtime.namespace().component(opts.component)
+                    .endpoint("embed"))
+        await embed_ep.serve_endpoint(
+            engine.embed_endpoint, advertise_host=opts.advertise_host
+        )
+
     # active canary probes through the real generate path
     # (ref: health_check.rs:44; enabled by DYNTPU_HEALTH_CHECK_ENABLED)
     if runtime.config.health_check_enabled:
@@ -95,8 +105,12 @@ async def serve_engine(
             )
 
     if tokenizer is not None:
+        model_type = ["chat", "completions"]
+        if supports_embeddings:
+            model_type.append("embeddings")
         card = ModelDeploymentCard(
             name=opts.name,
+            model_type=model_type,
             tokenizer_json=tokenizer.to_json_str(),
             chat_template=tokenizer.chat_template,
             context_length=eng_cfg.max_model_len,

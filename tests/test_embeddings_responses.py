@@ -1,0 +1,223 @@
+"""/v1/embeddings (encode-only engine step) and /v1/responses (Responses
+surface over the chat pipeline) — ref: lib/llm/src/http/service/openai.rs:714.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.frontend.service import HttpService, ModelEntry, ModelManager
+from dynamo_tpu.llm.discovery import (
+    ModelDeploymentCard, ModelWatcher, register_llm,
+)
+from dynamo_tpu.llm.entrypoint import (
+    EmbeddingsPipeline, build_routed_pipeline,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+from test_llm_pipeline import byte_tokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+# --------------------------- encode unit ------------------------------
+
+
+async def test_engine_embed_is_normalised_and_deterministic():
+    eng = InferenceEngine(
+        ModelConfig.tiny(vocab_size=256),
+        EngineConfig(num_blocks=32, max_model_len=128,
+                     max_num_batched_tokens=128, prefill_buckets=(128,),
+                     decode_buckets=(4,), max_num_seqs=4),
+    )
+    a, b = await eng.embed([[5, 6, 7, 8], [5, 6, 7, 8]])
+    (c,) = await eng.embed([[9, 10, 11]])
+    assert a == b
+    assert np.isclose(np.linalg.norm(a), 1.0, atol=1e-5)
+    assert a != c
+    assert len(a) == 64  # tiny hidden size
+    with pytest.raises(ValueError):
+        await eng.embed([[]])
+    await eng.stop()
+
+
+# ------------------------------ e2e -----------------------------------
+
+
+@pytest.fixture
+async def cluster():
+    """store + tiny worker (generate + embed endpoints) + HTTP frontend."""
+    store = StoreServer(host="127.0.0.1", port=0)
+    await store.start()
+    cfg = RuntimeConfig(store_addr=f"127.0.0.1:{store.port}")
+
+    worker_rt = await DistributedRuntime.from_settings(cfg)
+    tk = byte_tokenizer()
+    engine = InferenceEngine(
+        ModelConfig.tiny(vocab_size=512),
+        EngineConfig(num_blocks=128, max_model_len=256,
+                     max_num_batched_tokens=256,
+                     prefill_buckets=(256,), decode_buckets=(8,),
+                     max_num_seqs=8),
+    )
+    await engine.start()
+    ns = worker_rt.namespace("er")
+    ep = ns.component("backend").endpoint("generate")
+    await ep.serve_endpoint(engine)
+    await ns.component("backend").endpoint("embed").serve_endpoint(
+        engine.embed_endpoint
+    )
+    card = ModelDeploymentCard(
+        name="tiny-chat", tokenizer_json=tk.to_json_str(),
+        context_length=256, migration_limit=1,
+    )
+    await register_llm(ep, card)
+
+    front_rt = await DistributedRuntime.from_settings(cfg)
+    manager = ModelManager()
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          metrics=MetricsRegistry(prefix="test_er"))
+
+    async def on_add(card, entry):
+        endpoint = (front_rt.namespace(entry["namespace"])
+                    .component(entry["component"])
+                    .endpoint(entry["endpoint"]))
+        client = await endpoint.client()
+        embed_client = await (front_rt.namespace(entry["namespace"])
+                              .component(entry["component"])
+                              .endpoint("embed").client())
+        manager.register(ModelEntry(
+            name=card.name,
+            engine=build_routed_pipeline(card, client),
+            embed_engine=EmbeddingsPipeline(card, embed_client),
+        ))
+
+    watcher = ModelWatcher(front_rt, on_add, lambda n: manager.remove(n))
+    await watcher.start()
+    await service.start()
+    for _ in range(100):
+        if "tiny-chat" in manager:
+            break
+        await asyncio.sleep(0.1)
+
+    yield f"http://127.0.0.1:{service.port}"
+
+    await watcher.stop()
+    await service.stop()
+    await engine.stop()
+    await front_rt.shutdown()
+    await worker_rt.shutdown()
+    await store.stop()
+
+
+async def test_embeddings_endpoint(cluster):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{cluster}/v1/embeddings",
+            json={"model": "tiny-chat",
+                  "input": ["hello world", "something else"]},
+            timeout=aiohttp.ClientTimeout(total=120),
+        ) as r:
+            assert r.status == 200, await r.text()
+            body = await r.json()
+    assert body["object"] == "list"
+    assert len(body["data"]) == 2
+    assert body["data"][0]["object"] == "embedding"
+    assert body["data"][1]["index"] == 1
+    v0 = np.asarray(body["data"][0]["embedding"])
+    v1 = np.asarray(body["data"][1]["embedding"])
+    assert np.isclose(np.linalg.norm(v0), 1.0, atol=1e-5)
+    assert not np.allclose(v0, v1)
+    assert body["usage"]["prompt_tokens"] > 0
+
+
+async def test_embeddings_validation(cluster):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{cluster}/v1/embeddings",
+            json={"model": "tiny-chat"},
+        ) as r:
+            assert r.status == 400
+        async with s.post(
+            f"{cluster}/v1/embeddings",
+            json={"model": "nope", "input": "x"},
+        ) as r:
+            assert r.status == 404
+
+
+async def test_responses_endpoint_aggregated(cluster):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{cluster}/v1/responses",
+            json={"model": "tiny-chat", "input": "tell me a fact",
+                  "instructions": "be brief", "max_output_tokens": 6},
+            timeout=aiohttp.ClientTimeout(total=120),
+        ) as r:
+            assert r.status == 200, await r.text()
+            body = await r.json()
+    assert body["object"] == "response"
+    assert body["status"] == "completed"
+    msg = body["output"][0]
+    assert msg["type"] == "message" and msg["role"] == "assistant"
+    assert msg["content"][0]["type"] == "output_text"
+    assert body["usage"]["output_tokens"] == 6
+    assert body["usage"]["input_tokens"] > 0
+
+
+async def test_responses_matches_chat(cluster):
+    """The same seeded input through /v1/responses and /v1/chat/completions
+    yields the same text (aggregation parity)."""
+    payload = {"model": "tiny-chat", "max_output_tokens": 8,
+               "temperature": 0.8, "seed": 42,
+               "input": [{"role": "user", "content": "hi there"}]}
+    chat_payload = {"model": "tiny-chat", "max_tokens": 8,
+                    "temperature": 0.8, "seed": 42,
+                    "messages": [{"role": "user", "content": "hi there"}]}
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"{cluster}/v1/responses", json=payload,
+                          timeout=aiohttp.ClientTimeout(total=120)) as r:
+            assert r.status == 200, await r.text()
+            resp = await r.json()
+        async with s.post(f"{cluster}/v1/chat/completions",
+                          json=chat_payload,
+                          timeout=aiohttp.ClientTimeout(total=120)) as r:
+            assert r.status == 200, await r.text()
+            chat = await r.json()
+    assert (resp["output"][0]["content"][0]["text"]
+            == chat["choices"][0]["message"]["content"])
+
+
+async def test_responses_streaming_events(cluster):
+    payload = {"model": "tiny-chat", "input": "stream this",
+               "max_output_tokens": 6, "stream": True}
+    events = []
+    deltas = []
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"{cluster}/v1/responses", json=payload,
+                          timeout=aiohttp.ClientTimeout(total=120)) as r:
+            assert r.status == 200, await r.text()
+            current_event = None
+            async for raw in r.content:
+                line = raw.decode().strip()
+                if line.startswith("event: "):
+                    current_event = line[7:]
+                    events.append(current_event)
+                elif line.startswith("data: ") and line != "data: [DONE]":
+                    d = json.loads(line[6:])
+                    if current_event == "response.output_text.delta":
+                        deltas.append(d["delta"])
+                    elif current_event == "response.completed":
+                        completed = d
+    assert events[0] == "response.created"
+    assert events[-1] == "response.completed"
+    final_text = (completed["response"]["output"][0]["content"][0]["text"])
+    assert "".join(deltas) == final_text
+    assert completed["response"]["usage"]["output_tokens"] == 6
