@@ -36,8 +36,14 @@ async def main() -> None:
     client = await StoreClient.connect(args.store)
     key = f"planner/{args.namespace}/target/{args.component}"
     procs: list = []
+    # Python's default SIGTERM disposition would kill us without running
+    # the finally, orphaning every worker we spawned
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
     try:
-        while True:
+        while not stop.is_set():
             raw = await client.get(key)
             target = int(json.loads(raw)["replicas"]) if raw else len(procs)
             procs = [pr for pr in procs if pr.poll() is None]
@@ -48,10 +54,18 @@ async def main() -> None:
                 pr = procs.pop()
                 print(f"scale down -> {len(procs)}/{target}", flush=True)
                 pr.send_signal(signal.SIGTERM)   # graceful drain
-            await asyncio.sleep(args.poll)
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=args.poll)
+            except asyncio.TimeoutError:
+                pass
     finally:
         for pr in procs:
             pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(10)
+            except Exception:
+                pr.kill()
         await client.close()
 
 

@@ -457,25 +457,36 @@ class HttpService:
         first = True
         prev = None
         ws = self.window_stats
-        n_out = 0
-        async for out in outputs:
-            now = time.monotonic()
-            if first:
-                self._m_ttft.labels(model=model).observe(now - t0)
-                ws.ttft_sum += now - t0
-                ws.ttft_count += 1
-                ws.isl_sum += out.num_prompt_tokens
-                first = False
-            elif prev is not None:
-                self._m_itl.labels(model=model).observe(now - prev)
-                ws.itl_sum += now - prev
-                ws.itl_count += 1
-            prev = now
-            n_out += 1
-            yield out
-        if not first:
-            ws.num_requests += 1
-            ws.osl_sum += n_out
+        n_tokens = 0
+        # the finally accounts on EVERY termination path: downstream
+        # consumers (chat_stream) break at finish_reason and client
+        # disconnects close the generator chain — post-loop code after the
+        # async-for would never run (the planner saw num_requests=0
+        # forever), and counting only finished streams would skew isl_avg
+        # whenever requests abort mid-stream
+        try:
+            async for out in outputs:
+                now = time.monotonic()
+                if first:
+                    self._m_ttft.labels(model=model).observe(now - t0)
+                    ws.ttft_sum += now - t0
+                    ws.ttft_count += 1
+                    ws.isl_sum += out.num_prompt_tokens
+                    first = False
+                elif prev is not None:
+                    self._m_itl.labels(model=model).observe(now - prev)
+                    ws.itl_sum += now - prev
+                    ws.itl_count += 1
+                prev = now
+                # token count, not chunk count (a chunk can carry several
+                # token ids, or none during stop-string holdback)
+                n_tokens = (out.cum_tokens if out.cum_tokens
+                            else n_tokens + len(out.token_ids))
+                yield out
+        finally:
+            if not first:
+                ws.num_requests += 1
+                ws.osl_sum += n_tokens
 
     def _err(self, status: int, msg: str, model: str, endpoint: str) -> web.Response:
         self._m_requests.labels(

@@ -1,0 +1,177 @@
+"""Planner scaling e2e over real processes: HTTP load → frontend window
+stats → planner → VirtualConnector target → scale_watcher starts/stops
+mocker workers. The fleet scales 1→N under load and back to 1 on a trickle
+(ref scenario: tests/planner/test_scaling_e2e.py + sin_load_generator)."""
+
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from test_llm_pipeline import byte_tokenizer  # noqa: E402
+from utils import ManagedProcess, free_port  # noqa: E402
+
+pytestmark = pytest.mark.anyio
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def tokenizer_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    path.write_text(byte_tokenizer().to_json_str())
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def profile_file(tmp_path_factory):
+    """Synthetic perf curves tuned so the burst load needs >1 decode
+    replica and the trickle needs exactly 1."""
+    profile = {
+        "prefill_isl": [8, 64, 256],
+        "prefill_ttft_s": [0.01, 0.02, 0.05],
+        "prefill_thpt_per_chip": [2000.0, 2000.0, 2000.0],
+        "decode_kv_usage": [0.1, 0.5, 0.9],
+        "decode_context_length": [16, 64, 256],
+        "decode_itl_s": [0.005, 0.005, 0.005],
+        "decode_thpt_per_chip": [30.0, 30.0, 30.0],
+    }
+    path = tmp_path_factory.mktemp("prof") / "profile.json"
+    path.write_text(json.dumps(profile))
+    return str(path)
+
+
+async def test_planner_scales_fleet_up_and_down(tokenizer_file, profile_file):
+    store_port = free_port()
+    http_port = free_port()
+    procs = []
+    try:
+        store = ManagedProcess(
+            ["-m", "dynamo_tpu.runtime.store", "--host", "127.0.0.1",
+             "--port", str(store_port)],
+            name="store", ready_pattern=r"listening",
+        )
+        procs.append(store)
+        store.wait_ready(20)
+        env = {"DYNTPU_STORE_ADDR": f"127.0.0.1:{store_port}"}
+
+        # seed the scaling target so the watcher brings up the first worker
+        from dynamo_tpu.runtime.store import StoreClient
+
+        client = await StoreClient.connect(f"127.0.0.1:{store_port}")
+        await client.put(
+            "planner/dynamo/target/backend",
+            json.dumps({"replicas": 1, "ts": time.time(),
+                        "decision": 0}).encode(),
+        )
+
+        watcher = ManagedProcess(
+            ["deploy/scripts/scale_watcher.py",
+             "--store", f"127.0.0.1:{store_port}",
+             "--component", "backend", "--poll", "0.5", "--",
+             sys.executable, "-m", "dynamo_tpu.mocker",
+             "--model-name", "mock", "--tokenizer", tokenizer_file,
+             "--block-size", "4", "--num-blocks", "512",
+             "--max-model-len", "512", "--speedup-ratio", "50"],
+            name="watcher", env=env, ready_pattern=r"scale up -> 1/1",
+        )
+        procs.append(watcher)
+        watcher.wait_ready(30)
+
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.frontend", "--host", "127.0.0.1",
+             "--port", str(http_port), "--stats-publish-interval", "1"],
+            name="frontend", env=env, ready_pattern=r"frontend ready",
+        )
+        procs.append(frontend)
+        frontend.wait_ready(30)
+
+        planner = ManagedProcess(
+            ["-m", "dynamo_tpu.planner", "--profile", profile_file,
+             "--adjustment-interval", "2", "--max-chip-budget", "4",
+             "--ttft", "0.5", "--itl", "0.05"],
+            name="planner", env=env, ready_pattern=r"planner running",
+        )
+        procs.append(planner)
+        planner.wait_ready(30)
+
+        url = f"http://127.0.0.1:{http_port}/v1/chat/completions"
+        body = {"model": "mock", "max_tokens": 16,
+                "messages": [{"role": "user", "content": "load probe"}]}
+
+        async def fire(session, n):
+            async def one():
+                try:
+                    async with session.post(
+                        url, json=body,
+                        timeout=aiohttp.ClientTimeout(total=60),
+                    ) as r:
+                        await r.read()
+                        return r.status
+                except Exception:
+                    return 0
+
+            return await asyncio.gather(*(one() for _ in range(n)))
+
+        async def instances() -> int:
+            kvs = await client.get_prefix("v1/instances/")
+            return sum(1 for k, _ in kvs if "/generate/" in k)
+
+        async def target() -> int:
+            raw = await client.get("planner/dynamo/target/backend")
+            return int(json.loads(raw)["replicas"]) if raw else 0
+
+        # wait until the first mocker is discovered by the frontend
+        async with aiohttp.ClientSession() as session:
+            for _ in range(100):
+                statuses = await fire(session, 1)
+                if statuses == [200]:
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                pytest.fail("fleet never served the warmup request")
+
+            # ---- burst phase: load that needs >1 decode replica ----------
+            # paced so the AR predictor sees a plateau, not an unbounded
+            # ramp it would extrapolate far past the real load
+            max_target = 1
+            max_instances = 1
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                await fire(session, 16)
+                await asyncio.sleep(0.4)
+                max_target = max(max_target, await target())
+                max_instances = max(max_instances, await instances())
+                if max_target > 1 and max_instances > 1:
+                    break
+            assert max_target > 1, "planner never scaled the target above 1"
+            assert max_instances > 1, (
+                "scale_watcher never realised the scale-up"
+            )
+
+            # ---- trickle phase: load a single replica satisfies ----------
+            deadline = time.monotonic() + 90
+            down_target = down_instances = None
+            while time.monotonic() < deadline:
+                await fire(session, 1)
+                await asyncio.sleep(1.0)
+                t, i = await target(), await instances()
+                if t == 1 and i == 1:
+                    down_target, down_instances = t, i
+                    break
+            assert down_target == 1, "planner never scaled back down to 1"
+            assert down_instances == 1, (
+                "scale_watcher never terminated the extra workers"
+            )
+        await client.close()
+    finally:
+        for p in reversed(procs):
+            try:
+                p.terminate()
+            except Exception:
+                pass
