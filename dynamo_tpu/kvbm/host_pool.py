@@ -49,6 +49,9 @@ class HostBlockPool:
         self._mem: "OrderedDict[int, Dict[str, np.ndarray]]" = OrderedDict()
         self._disk: "OrderedDict[int, Path]" = OrderedDict()
         self.stats = HostPoolStats()
+        # called with a seq_hash that left the pool entirely (distributed
+        # KVBM retracts its presence advertisement)
+        self.on_drop = None
 
     # -- query --
 
@@ -99,6 +102,8 @@ class HostBlockPool:
     def _spill(self, seq_hash: int, data: Dict[str, np.ndarray]) -> None:
         if self.disk_dir is None or self.disk_capacity <= 0:
             self.stats.drops += 1
+            if self.on_drop is not None:
+                self.on_drop(seq_hash)
             return
         if seq_hash in self._disk:
             return
@@ -115,11 +120,13 @@ class HostBlockPool:
         self._disk[seq_hash] = path
         self.stats.spills += 1
         while len(self._disk) > self.disk_capacity:
-            _, old_path = self._disk.popitem(last=False)
+            old_hash, old_path = self._disk.popitem(last=False)
             try:
                 os.unlink(old_path)
             except OSError:
                 pass
+            if self.on_drop is not None:
+                self.on_drop(old_hash)
         self._refresh()
 
     def _refresh(self) -> None:

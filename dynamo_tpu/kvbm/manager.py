@@ -46,6 +46,7 @@ class KvbmStats:
     invalidated_pending: int = 0
     g4_puts: int = 0
     g4_hits: int = 0
+    peer_hits: int = 0      # blocks onboarded from a peer worker's G2
 
 
 class StoreRemoteTier:
@@ -104,6 +105,7 @@ class KvbmManager:
             self.config.disk_blocks,
         )
         self.remote = remote   # G4 tier (None = disabled)
+        self.peers = None      # distributed peer-G2 plane (kvbm.distributed)
         self.stats = KvbmStats()
         # seq_hash -> candidate awaiting offload; insertion-ordered
         self._pending: Dict[int, _Pending] = {}
@@ -158,6 +160,13 @@ class KvbmManager:
                     self.stats.g4_puts += 1
                 except Exception:
                     log.exception("G4 put failed for %x", p.seq_hash)
+        if self.peers is not None:
+            try:  # one batched presence update, not a put per block
+                await self.peers.publish_many(
+                    [p.seq_hash for p in batch]
+                )
+            except Exception:
+                log.exception("peer G2 publish failed")
         self.stats.offloaded_blocks += len(batch)
         return len(batch)
 
@@ -168,12 +177,35 @@ class KvbmManager:
         prefix cache (adopt + one batched scatter). Returns blocks
         onboarded. Called by the engine at admission, before scheduling."""
         pool = self.engine.scheduler.pool
+        peer_hits_before = self.stats.peer_hits
+        candidates = token_seq.blocks[: self.config.max_onboard_blocks]
+        peer_data: Dict[int, Dict[str, np.ndarray]] = {}
+        if self.peers is not None:
+            # one batched peer lookup+fetch for every locally-missing hash
+            # (a per-block round-trip would serialise hundreds of RTTs at
+            # admission); may over-fetch past the first break point, bounded
+            # by max_onboard_blocks
+            need = [
+                tb.sequence_hash for tb in candidates
+                if not pool.contains(tb.sequence_hash)
+                and tb.sequence_hash not in self.host_pool
+            ]
+            if need:
+                try:
+                    peer_data = await self.peers.fetch_many(need)
+                except Exception:
+                    log.exception("peer G2 batch fetch failed")
         adopted: List[Tuple[int, Dict[str, np.ndarray]]] = []
         try:
-            for tb in token_seq.blocks[: self.config.max_onboard_blocks]:
+            for tb in candidates:
                 if pool.contains(tb.sequence_hash):
                     continue  # native G1 hit — prefix matching will take it
                 data = self.host_pool.get(tb.sequence_hash)
+                if data is None:
+                    data = peer_data.get(tb.sequence_hash)
+                    if data is not None:
+                        self.stats.peer_hits += 1
+                        self.host_pool.put(tb.sequence_hash, data)
                 if data is None and self.remote is not None:
                     try:
                         data = await self.remote.get(tb.sequence_hash)
@@ -210,5 +242,11 @@ class KvbmManager:
         self.stats.onboarded_blocks += len(adopted)
         if adopted:
             self.stats.onboard_requests += 1
-            log.debug("onboarded %d blocks from host tier", len(adopted))
+            peer_blocks = self.stats.peer_hits - peer_hits_before
+            if peer_blocks:
+                log.info("onboarded %d blocks (%d from peer G2)",
+                         len(adopted), peer_blocks)
+            else:
+                log.debug("onboarded %d blocks from host tier",
+                          len(adopted))
         return len(adopted)

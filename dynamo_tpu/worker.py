@@ -82,6 +82,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
     p.add_argument("--kvbm-remote", action="store_true",
                    help="enable the G4 cluster-shared tier in the store")
+    p.add_argument("--kvbm-distributed", action="store_true",
+                   help="share the G2 host tier across workers (presence "
+                        "keys in the store + direct TCP block fetch; ref: "
+                        "block_manager/distributed)")
+    p.add_argument("--kvbm-group", default=None,
+                   help="distributed-KVBM group name for barrier bring-up")
+    p.add_argument("--kvbm-group-role", choices=["leader", "worker"],
+                   default="worker")
+    p.add_argument("--kvbm-group-size", type=int, default=1,
+                   help="worker count the group leader waits for")
     # multi-host SPMD (one process per host of a slice; flags default to
     # the JAX_* env vars so TPU pod launchers can set them uniformly)
     import os
@@ -194,6 +204,40 @@ async def run_worker(args: argparse.Namespace) -> None:
             disk_blocks=args.kvbm_disk_blocks,
         ), remote=remote)
 
+    kvbm_dist = None
+    if (args.kvbm_distributed or args.kvbm_group) and engine.kvbm is None:
+        # silently skipping would leave a group leader waiting at the
+        # barrier for a worker that never checks in
+        raise SystemExit(
+            "--kvbm-distributed/--kvbm-group require KVBM "
+            "(--kvbm-host-blocks > 0)"
+        )
+    if args.kvbm_distributed:
+        from .kvbm.distributed import (
+            DistributedKvbm, KvbmGroup, engine_layout,
+        )
+
+        kvbm_dist = DistributedKvbm(
+            engine.kvbm, runtime.store, runtime.primary_lease,
+            namespace=config.namespace, advertise_host=args.advertise_host,
+            scope=name,
+        )
+        await kvbm_dist.start()
+        if args.kvbm_group:
+            layout = engine_layout(engine)
+            if args.kvbm_group_role == "leader":
+                await KvbmGroup.lead(
+                    runtime.store, args.kvbm_group, args.kvbm_group_size,
+                    layout,
+                )
+            else:
+                await KvbmGroup.join(
+                    runtime.store, args.kvbm_group,
+                    f"worker-{runtime.primary_lease}", layout,
+                )
+            log.info("kvbm group %s formed (%s)", args.kvbm_group,
+                     args.kvbm_group_role)
+
     handler = None
     queue_worker = None
     component = args.component
@@ -257,6 +301,8 @@ async def run_worker(args: argparse.Namespace) -> None:
     finally:
         if queue_worker is not None:
             await queue_worker.stop()
+        if kvbm_dist is not None:
+            await kvbm_dist.stop()
         if hasattr(handler, "close"):
             handler.close()
 

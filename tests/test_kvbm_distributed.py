@@ -1,0 +1,228 @@
+"""Distributed KVBM: peer-G2 presence/fetch plane + leader/worker group
+bring-up (ref: lib/llm/src/block_manager/distributed/{leader,worker}.rs)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.kvbm.distributed import (
+    DistributedKvbm, KvbmGroup, engine_layout,
+)
+from dynamo_tpu.kvbm.manager import KvbmConfig
+from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+pytestmark = pytest.mark.anyio
+
+
+def make_engine(seed=0):
+    return InferenceEngine(
+        ModelConfig.tiny(vocab_size=256),
+        EngineConfig(num_blocks=64, block_size=4, max_model_len=128,
+                     max_num_batched_tokens=128, prefill_buckets=(128,),
+                     decode_buckets=(4,), max_num_seqs=4),
+        seed=seed,
+    )
+
+
+async def _run(engine, prompt, n=4, rid="r"):
+    req = Request(request_id=rid, token_ids=prompt, max_tokens=n,
+                  temperature=0.0, ignore_eos=True)
+    return [out.token_id async for out in engine.submit(req)]
+
+
+@pytest.fixture
+async def pair():
+    """Two engines with distributed KVBM over one real store + real TCP."""
+    store_server = StoreServer(host="127.0.0.1", port=0)
+    await store_server.start()
+    addr = f"127.0.0.1:{store_server.port}"
+    items = []
+    for i in (1, 2):
+        engine = make_engine(seed=0)  # same weights
+        manager = engine.attach_kvbm(KvbmConfig(host_blocks=64))
+        store = await StoreClient.connect(addr)
+        dist = DistributedKvbm(manager, store, worker_id=i)
+        await dist.start()
+        items.append((engine, manager, dist, store))
+
+    yield items
+
+    for engine, _manager, dist, store in items:
+        await dist.stop()
+        await engine.stop()
+        await store.close()
+    await store_server.stop()
+
+
+async def test_onboard_hits_peer_g2(pair):
+    """Worker B onboards a prefix that only worker A's G2 holds — over the
+    presence plane + TCP fetch — and decodes identically to a cold run."""
+    (eng_a, man_a, dist_a, _), (eng_b, man_b, dist_b, _) = pair
+    prompt = list(range(1, 33))  # 8 blocks of 4
+
+    got_a = await _run(eng_a, prompt, rid="warm-a")
+    # idle drain offloads sealed blocks to A's G2 and publishes presence
+    for _ in range(100):
+        if man_a.stats.offloaded_blocks >= 8 and dist_a.num_published >= 8:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        pytest.fail("worker A never offloaded/published its blocks")
+
+    got_b = await _run(eng_b, prompt, rid="warm-b")
+    assert man_b.stats.peer_hits >= 8, "no peer-G2 onboard hit"
+    assert dist_a.num_served >= 8
+    assert got_b == got_a  # token-exact across the peer transfer
+
+    # reference: a third cold engine with the same weights
+    ref = make_engine(seed=0)
+    want = await _run(ref, prompt, rid="cold")
+    await ref.stop()
+    assert got_b == want
+
+
+async def test_stale_presence_key_is_dropped(pair):
+    (eng_a, man_a, dist_a, _), (_eng_b, _man_b, dist_b, _) = pair
+    prompt = list(range(40, 72))
+    await _run(eng_a, prompt, rid="stale-a")
+    for _ in range(100):
+        if dist_a.num_published >= 8:
+            break
+        await asyncio.sleep(0.05)
+    # simulate A evicting its whole G2 (no disk tier configured)
+    man_a.host_pool._mem.clear()
+    from dynamo_tpu.tokens import compute_block_hashes_for_seq
+
+    h = compute_block_hashes_for_seq(prompt, 4)[0]
+    assert man_a.host_pool.get(h) is None
+    got = await dist_b.fetch(h)
+    assert got is None
+    assert dist_b.num_stale_keys >= 1
+
+
+async def test_group_barrier_validates_layout():
+    store_server = StoreServer(host="127.0.0.1", port=0)
+    await store_server.start()
+    addr = f"127.0.0.1:{store_server.port}"
+    leader_store = await StoreClient.connect(addr)
+    worker_store = await StoreClient.connect(addr)
+    bad_store = await StoreClient.connect(addr)
+
+    eng = make_engine()
+    layout = engine_layout(eng)
+    bad_layout = dict(layout, block_size=8)
+
+    lead = asyncio.create_task(
+        KvbmGroup.lead(leader_store, "g1", 2, layout, timeout_s=20)
+    )
+    ok = asyncio.create_task(
+        KvbmGroup.join(worker_store, "g1", "w1", layout, timeout_s=20)
+    )
+    bad = asyncio.create_task(
+        KvbmGroup.join(bad_store, "g1", "w2", bad_layout, timeout_s=20)
+    )
+    assert await ok == layout
+    with pytest.raises(RuntimeError, match="layout mismatch"):
+        await bad
+    await lead  # both workers checked in; leader returns
+    await eng.stop()
+    for c in (leader_store, worker_store, bad_store):
+        await c.close()
+    await store_server.stop()
+
+
+# -------------------------- process-level e2e --------------------------
+
+
+async def test_peer_onboard_across_processes(tmp_path_factory):
+    """Two worker PROCESSES with distributed KVBM (group barrier bring-up):
+    a prefix prefilled and offloaded on one worker is onboarded from its G2
+    by the other worker over the presence plane + TCP fetch."""
+    import sys
+    from pathlib import Path
+
+    import aiohttp
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_llm_pipeline import byte_tokenizer
+    from utils import ManagedProcess, free_port
+
+    tok = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.write_text(byte_tokenizer().to_json_str())
+    store_port, http_port = free_port(), free_port()
+    procs = []
+    try:
+        store = ManagedProcess(
+            ["-m", "dynamo_tpu.runtime.store", "--host", "127.0.0.1",
+             "--port", str(store_port)],
+            name="store", ready_pattern=r"listening",
+        )
+        procs.append(store)
+        store.wait_ready(20)
+        env = {"DYNTPU_STORE_ADDR": f"127.0.0.1:{store_port}"}
+        common = ["-m", "dynamo_tpu.worker", "--model", "tiny",
+                  "--model-name", "tiny-chat", "--tokenizer", str(tok),
+                  "--block-size", "4", "--num-blocks", "128",
+                  "--max-model-len", "256", "--max-batched-tokens", "256",
+                  "--kvbm-host-blocks", "64", "--kvbm-distributed",
+                  "--kvbm-group", "pg", "--kvbm-group-size", "1"]
+        workers = [
+            ManagedProcess(common + ["--kvbm-group-role", "leader"],
+                           name="worker-a", env=env,
+                           ready_pattern=r"worker ready"),
+            ManagedProcess(common + ["--kvbm-group-role", "worker"],
+                           name="worker-b", env=env,
+                           ready_pattern=r"worker ready"),
+        ]
+        procs.extend(workers)
+        for w in workers:
+            w.wait_ready(90)
+        frontend = ManagedProcess(
+            ["-m", "dynamo_tpu.frontend", "--host", "127.0.0.1",
+             "--port", str(http_port)],
+            name="frontend", env=env, ready_pattern=r"frontend ready",
+        )
+        procs.append(frontend)
+        frontend.wait_ready(30)
+
+        body = {"model": "tiny-chat", "max_tokens": 4,
+                "messages": [{
+                    "role": "user",
+                    "content": "a sufficiently long shared prefix that "
+                               "spans plenty of kv blocks for the peer "
+                               "transfer to be observable",
+                }]}
+        url = f"http://127.0.0.1:{http_port}/v1/chat/completions"
+        texts = []
+        async with aiohttp.ClientSession() as s:
+            # round-robin spreads these over both workers; the second
+            # worker to see the prompt onboards from the first one's G2
+            for i in range(4):
+                async with s.post(
+                    url, json=body,
+                    timeout=aiohttp.ClientTimeout(total=120),
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+                    texts.append(out["choices"][0]["message"]["content"])
+                await asyncio.sleep(1.0)  # allow idle offload+publish
+
+        def peer_logged():
+            return any("from peer G2" in w.log() for w in workers)
+
+        for _ in range(100):
+            if peer_logged():
+                break
+            await asyncio.sleep(0.1)
+        assert peer_logged(), "no worker onboarded from a peer's G2"
+        # greedy decode: every completion identical regardless of which
+        # worker served it and where the prefix came from
+        assert len(set(texts)) == 1
+    finally:
+        for p in reversed(procs):
+            try:
+                p.terminate()
+            except Exception:
+                pass
