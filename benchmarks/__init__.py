@@ -1,0 +1,1 @@
+"""Benchmark harness (ref role: benchmarks/ — load generation, prefix-structured data, router benchmarks, KV-plane microbenchmarks)."""

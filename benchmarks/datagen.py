@@ -1,0 +1,163 @@
+"""Benchmark data generators: prefix-structured prompts + load schedules
+(role of the reference's ``benchmarks/data_generator`` — the
+prefix-structured dataset its router benchmarks use — and the sinusoidal
+load generator ``sin_load_generator.py``).
+
+The prefix generator builds a tree of shared prefixes: ``groups`` root
+prefixes, each with ``branches`` second-level continuations, each yielding
+requests whose leading tokens repeat across the group. ``prefix_ratio`` of
+every prompt is shared content — the knob the router benchmark sweeps to
+show KV-aware routing beating round-robin as prefix reuse grows.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
+
+
+@dataclass
+class PrefixDatasetConfig:
+    num_requests: int = 128
+    isl: int = 256                 # total prompt tokens
+    prefix_ratio: float = 0.5      # leading fraction shared within a group
+    groups: int = 4                # distinct root prefixes
+    branches: int = 2              # second-level shared continuations
+    vocab_size: int = 10_000
+    vocab_offset: int = 100        # keep clear of special ids
+    seed: int = 0
+
+
+@dataclass
+class GeneratedRequest:
+    token_ids: List[int]
+    group: int
+    branch: int
+
+
+def generate_prefix_dataset(
+    cfg: PrefixDatasetConfig,
+) -> List[GeneratedRequest]:
+    """Prompts with controlled prefix sharing.
+
+    Layout per prompt: ``[group prefix | branch prefix | unique tail]``
+    where the two shared segments together cover ``prefix_ratio`` of the
+    prompt (2/3 group-shared, 1/3 branch-shared).
+    """
+    rng = random.Random(cfg.seed)
+
+    def toks(n: int) -> List[int]:
+        return [rng.randrange(cfg.vocab_offset,
+                              cfg.vocab_offset + cfg.vocab_size)
+                for _ in range(n)]
+
+    shared = max(0, min(cfg.isl, int(cfg.isl * cfg.prefix_ratio)))
+    group_len = (shared * 2) // 3
+    branch_len = shared - group_len
+    tail_len = cfg.isl - shared
+
+    group_prefixes = [toks(group_len) for _ in range(cfg.groups)]
+    branch_prefixes = [
+        [toks(branch_len) for _ in range(cfg.branches)]
+        for _ in range(cfg.groups)
+    ]
+    out: List[GeneratedRequest] = []
+    for i in range(cfg.num_requests):
+        g = rng.randrange(cfg.groups)
+        b = rng.randrange(cfg.branches)
+        out.append(GeneratedRequest(
+            token_ids=(group_prefixes[g] + branch_prefixes[g][b]
+                       + toks(tail_len)),
+            group=g, branch=b,
+        ))
+    return out
+
+
+# ----------------------------- load schedules -----------------------------
+
+
+@dataclass
+class LoadSchedule:
+    """Request arrival times (seconds from start) for open-loop driving.
+
+    kinds:
+      constant — ``rate`` req/s
+      sin      — rate oscillates between ``rate*(1-amplitude)`` and
+                 ``rate*(1+amplitude)`` with ``period_s``
+                 (ref: sin_load_generator.py)
+      burst    — ``rate`` for the first half, ``rate*amplitude`` after
+    """
+
+    kind: str = "constant"
+    rate: float = 4.0              # mean requests/second
+    duration_s: float = 30.0
+    period_s: float = 20.0         # sin period
+    amplitude: float = 0.8         # sin modulation depth / burst ratio
+    seed: int = 0
+
+    def arrival_times(self) -> List[float]:
+        rng = random.Random(self.seed)
+        times: List[float] = []
+        t = 0.0
+        while t < self.duration_s:
+            if self.kind == "sin":
+                inst = self.rate * (
+                    1.0 + self.amplitude
+                    * math.sin(2 * math.pi * t / self.period_s)
+                )
+            elif self.kind == "burst":
+                inst = (self.rate if t < self.duration_s / 2
+                        else self.rate * self.amplitude)
+            else:
+                inst = self.rate
+            inst = max(inst, 1e-3)
+            # Poisson arrivals at the instantaneous rate
+            t += rng.expovariate(inst)
+            if t < self.duration_s:
+                times.append(t)
+        return times
+
+
+# ------------------------------- metrics ----------------------------------
+
+
+def percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(q / 100.0 * (len(vals) - 1))))
+    return vals[idx]
+
+
+@dataclass
+class RequestRecord:
+    start: float
+    ttft: Optional[float] = None
+    end: Optional[float] = None
+    output_tokens: int = 0
+    itls: List[float] = field(default_factory=list)
+    error: Optional[str] = None
+
+
+def summarize(records: List[RequestRecord], elapsed_s: float) -> dict:
+    ok = [r for r in records if r.error is None and r.end is not None]
+    ttfts = [r.ttft for r in ok if r.ttft is not None]
+    itls = [x for r in ok for x in r.itls]
+    out_tokens = sum(r.output_tokens for r in ok)
+    return {
+        "requests": len(records),
+        "completed": len(ok),
+        "errors": len(records) - len(ok),
+        "elapsed_s": round(elapsed_s, 2),
+        "request_throughput_rps": round(len(ok) / max(elapsed_s, 1e-9), 2),
+        "output_tok_s": round(out_tokens / max(elapsed_s, 1e-9), 1),
+        "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 1),
+        "ttft_p90_ms": round(percentile(ttfts, 90) * 1e3, 1),
+        "ttft_p99_ms": round(percentile(ttfts, 99) * 1e3, 1),
+        "ttft_avg_ms": round(
+            sum(ttfts) / len(ttfts) * 1e3 if ttfts else 0.0, 1),
+        "itl_p50_ms": round(percentile(itls, 50) * 1e3, 2),
+        "itl_p99_ms": round(percentile(itls, 99) * 1e3, 2),
+    }

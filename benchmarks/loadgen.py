@@ -1,0 +1,169 @@
+"""genai-perf-shaped HTTP load driver (role of the reference's
+``benchmarks/`` harness: closed-loop fixed-concurrency or open-loop
+scheduled arrivals, streaming SSE measurement of TTFT/ITL, one JSON
+report).
+
+    python -m benchmarks.loadgen --url http://127.0.0.1:8000 \
+        --model mock --concurrency 8 --requests 64 --isl 256 --osl 32
+
+    python -m benchmarks.loadgen --url ... --schedule sin --rate 8 \
+        --duration 60 --period 30
+
+Prompts come from the prefix-structured generator (benchmarks/datagen.py)
+so prefix reuse is controllable (``--prefix-ratio``); they ride
+``/v1/completions`` as pre-tokenised arrays, skipping tokenizer effects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+import aiohttp
+
+from .datagen import (
+    GeneratedRequest, LoadSchedule, PrefixDatasetConfig, RequestRecord,
+    generate_prefix_dataset, summarize,
+)
+
+
+async def run_one(
+    session: aiohttp.ClientSession,
+    url: str,
+    model: str,
+    req: GeneratedRequest,
+    osl: int,
+    record: RequestRecord,
+    timeout_s: float = 300.0,
+) -> None:
+    body = {
+        "model": model,
+        "prompt": req.token_ids,
+        "max_tokens": osl,
+        "ignore_eos": True,
+        "stream": True,
+    }
+    t0 = time.monotonic()
+    record.start = t0
+    prev: Optional[float] = None
+    try:
+        async with session.post(
+            f"{url}/v1/completions", json=body,
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            if resp.status != 200:
+                record.error = f"http {resp.status}"
+                return
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                payload = json.loads(line[6:])
+                text = payload["choices"][0].get("text")
+                now = time.monotonic()
+                if text:
+                    if record.ttft is None:
+                        record.ttft = now - t0
+                    elif prev is not None:
+                        record.itls.append(now - prev)
+                    prev = now
+                    record.output_tokens += 1
+        record.end = time.monotonic()
+    except Exception as exc:  # noqa: BLE001 — per-request isolation
+        record.error = f"{type(exc).__name__}: {exc}"
+
+
+async def closed_loop(
+    url: str, model: str, dataset: List[GeneratedRequest], osl: int,
+    concurrency: int,
+) -> dict:
+    records = [RequestRecord(start=0.0) for _ in dataset]
+    sem = asyncio.Semaphore(concurrency)
+    t0 = time.monotonic()
+    async with aiohttp.ClientSession() as session:
+
+        async def gated(i: int) -> None:
+            async with sem:
+                await run_one(session, url, model, dataset[i], osl,
+                              records[i])
+
+        await asyncio.gather(*(gated(i) for i in range(len(dataset))))
+    report = summarize(records, time.monotonic() - t0)
+    report["mode"] = f"closed_loop(c={concurrency})"
+    return report
+
+
+async def open_loop(
+    url: str, model: str, dataset: List[GeneratedRequest], osl: int,
+    schedule: LoadSchedule,
+) -> dict:
+    times = schedule.arrival_times()
+    n = min(len(times), len(dataset))
+    records = [RequestRecord(start=0.0) for _ in range(n)]
+    t0 = time.monotonic()
+    async with aiohttp.ClientSession() as session:
+
+        async def timed(i: int) -> None:
+            delay = times[i] - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await run_one(session, url, model, dataset[i], osl, records[i])
+
+        await asyncio.gather(*(timed(i) for i in range(n)))
+    report = summarize(records, time.monotonic() - t0)
+    report["mode"] = (f"open_loop({schedule.kind}, rate={schedule.rate}, "
+                      f"duration={schedule.duration_s}s)")
+    return report
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu load generator")
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--model", default="mock")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--isl", type=int, default=256)
+    p.add_argument("--osl", type=int, default=32)
+    p.add_argument("--prefix-ratio", type=float, default=0.0)
+    p.add_argument("--prefix-groups", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop concurrency (ignored with --schedule)")
+    p.add_argument("--schedule", choices=["constant", "sin", "burst"],
+                   default=None, help="open-loop arrival schedule")
+    p.add_argument("--rate", type=float, default=4.0)
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--period", type=float, default=20.0)
+    p.add_argument("--amplitude", type=float, default=0.8)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    dataset = generate_prefix_dataset(PrefixDatasetConfig(
+        num_requests=args.requests, isl=args.isl,
+        prefix_ratio=args.prefix_ratio, groups=args.prefix_groups,
+        seed=args.seed,
+    ))
+    if args.schedule:
+        report = asyncio.run(open_loop(
+            args.url, args.model, dataset, args.osl,
+            LoadSchedule(kind=args.schedule, rate=args.rate,
+                         duration_s=args.duration, period_s=args.period,
+                         amplitude=args.amplitude, seed=args.seed),
+        ))
+    else:
+        report = asyncio.run(closed_loop(
+            args.url, args.model, dataset, args.osl, args.concurrency,
+        ))
+    report["isl"] = args.isl
+    report["osl"] = args.osl
+    report["prefix_ratio"] = args.prefix_ratio
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()

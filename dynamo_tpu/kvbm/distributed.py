@@ -63,17 +63,24 @@ class KvbmGroup:
     @staticmethod
     async def join(store, name: str, worker_name: str, layout: dict,
                    timeout_s: float = 120.0) -> dict:
-        """Worker side: join the barrier and validate layout compatibility."""
-        leader_layout = await WorkerBarrier(
-            f"kvbm/{name}", worker_name, timeout_s=timeout_s
-        ).sync(store, layout)
+        """Worker side: validate layout compatibility BEFORE checking in —
+        posting the barrier key first would satisfy the leader's count and
+        let it report a 'formed' group missing this worker."""
+        from ..runtime.component import BARRIER_ROOT
+
+        [(_k, raw)] = await store.wait_for_key_count(
+            f"{BARRIER_ROOT}kvbm/{name}/data", 1, timeout_s=timeout_s
+        )
+        leader_layout = msgpack.unpackb(raw, raw=False)
         if leader_layout != layout:
             raise RuntimeError(
                 f"KVBM layout mismatch: leader {leader_layout} != "
                 f"worker {layout} — cross-host KV transfer would corrupt "
                 f"the paged cache"
             )
-        return leader_layout
+        return await WorkerBarrier(
+            f"kvbm/{name}", worker_name, timeout_s=timeout_s
+        ).sync(store, layout)
 
 
 class DistributedKvbm:
@@ -159,15 +166,23 @@ class DistributedKvbm:
 
     async def publish_many(self, seq_hashes: Iterable[int]) -> None:
         """Batch-advertise (independent small writes, issued concurrently)
-        and retract advertisements for blocks G2 has since dropped."""
+        and retract advertisements for blocks G2 has since dropped.
+
+        Pool membership at publish time is the single source of truth: a
+        hash can appear in both lists (evicted then re-offloaded, or
+        evicted mid-tick by a later batch member), and a concurrent
+        put+delete of the same key would race."""
         payload = msgpack.packb({"addr": self.addr})
+        dropped, self._dropped = self._dropped, []
+        pool = self.manager.host_pool
+        put_hashes = {h for h in seq_hashes if h in pool}
+        drop_hashes = {h for h in dropped if h not in pool} - put_hashes
         puts = [
             self.store.put(self._key(h), payload,
                            lease=self.store.primary_lease)
-            for h in seq_hashes
+            for h in put_hashes
         ]
-        dropped, self._dropped = self._dropped, []
-        deletes = [self.store.delete(self._key(h)) for h in dropped]
+        deletes = [self.store.delete(self._key(h)) for h in drop_hashes]
         results = await asyncio.gather(*puts, *deletes,
                                        return_exceptions=True)
         for r in results:

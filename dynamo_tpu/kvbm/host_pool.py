@@ -116,6 +116,8 @@ class HostBlockPool:
             np.savez(path, k=k, v=v, dtype=dtype)
         except Exception:
             log.exception("G3 spill failed for %x", seq_hash)
+            if self.on_drop is not None:  # the block is gone — retract
+                self.on_drop(seq_hash)
             return
         self._disk[seq_hash] = path
         self.stats.spills += 1
@@ -125,7 +127,9 @@ class HostBlockPool:
                 os.unlink(old_path)
             except OSError:
                 pass
-            if self.on_drop is not None:
+            # fire only when the block left the pool ENTIRELY — a G3 copy
+            # of a block promoted back to G2 stays servable from _mem
+            if self.on_drop is not None and old_hash not in self._mem:
                 self.on_drop(old_hash)
         self._refresh()
 

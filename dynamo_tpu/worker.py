@@ -205,9 +205,11 @@ async def run_worker(args: argparse.Namespace) -> None:
         ), remote=remote)
 
     kvbm_dist = None
-    if (args.kvbm_distributed or args.kvbm_group) and engine.kvbm is None:
-        # silently skipping would leave a group leader waiting at the
-        # barrier for a worker that never checks in
+    if args.kvbm_group:
+        # a group member that never starts the presence plane would leave
+        # the leader waiting at the barrier for a check-in that never comes
+        args.kvbm_distributed = True
+    if args.kvbm_distributed and engine.kvbm is None:
         raise SystemExit(
             "--kvbm-distributed/--kvbm-group require KVBM "
             "(--kvbm-host-blocks > 0)"

@@ -114,19 +114,24 @@ async def test_group_barrier_validates_layout():
     layout = engine_layout(eng)
     bad_layout = dict(layout, block_size=8)
 
+    # leader waits for ONE worker; the mismatched worker must abort
+    # WITHOUT checking in (its barrier key would make the leader report a
+    # formed group missing a member)
     lead = asyncio.create_task(
-        KvbmGroup.lead(leader_store, "g1", 2, layout, timeout_s=20)
-    )
-    ok = asyncio.create_task(
-        KvbmGroup.join(worker_store, "g1", "w1", layout, timeout_s=20)
+        KvbmGroup.lead(leader_store, "g1", 1, layout, timeout_s=20)
     )
     bad = asyncio.create_task(
         KvbmGroup.join(bad_store, "g1", "w2", bad_layout, timeout_s=20)
     )
-    assert await ok == layout
     with pytest.raises(RuntimeError, match="layout mismatch"):
         await bad
-    await lead  # both workers checked in; leader returns
+    assert not lead.done(), "mismatched worker satisfied the barrier"
+    ok = asyncio.create_task(
+        KvbmGroup.join(worker_store, "g1", "w1", layout, timeout_s=20)
+    )
+    assert await ok == layout
+    payloads = await lead  # exactly the good worker checked in
+    assert payloads == [layout]
     await eng.stop()
     for c in (leader_store, worker_store, bad_store):
         await c.close()
