@@ -57,6 +57,14 @@ class MultihostConfig:
     num_hosts: int = 1
     host_index: int = 0
     barrier_timeout_s: float = 300.0
+    # failure detection on the step stream: the leader emits a heartbeat
+    # per idle interval; a follower that sees NOTHING (plans or beats) for
+    # heartbeat_timeout_s declares the leader dead and exits so the
+    # supervisor restarts the group. (SPMD over one mesh cannot re-elect:
+    # a surviving subset would deadlock in collectives missing the dead
+    # host's devices — fast detection + group restart IS the failover.)
+    heartbeat_interval_s: float = 2.0
+    heartbeat_timeout_s: float = 10.0
 
     @property
     def enabled(self) -> bool:
@@ -115,10 +123,15 @@ class StepBroadcaster:
     the engine's step-executor thread; delivery hops to the event loop.
     """
 
+    # a follower this many plans behind is wedged (its TCP connection is
+    # open but nothing drains); unbounded buffering would eat the leader
+    MAX_LAG = 10_000
+
     def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
         self._loop = loop or asyncio.get_event_loop()
         self._queues: Dict[int, asyncio.Queue] = {}
         self.num_plans = 0
+        self.num_dropped_followers = 0
 
     def sink(self, kind: str, arrays: Dict[str, np.ndarray]) -> None:
         plan = encode_plan(kind, arrays)
@@ -126,7 +139,21 @@ class StepBroadcaster:
 
     def _fanout(self, plan: dict) -> None:
         self.num_plans += 1
-        for q in self._queues.values():
+        for host, q in list(self._queues.items()):
+            if q.qsize() > self.MAX_LAG:
+                log.error("follower %d wedged (%d plans behind) — dropping"
+                          " it; the group must restart", host, q.qsize())
+                self.unsubscribe(host)
+                self.num_dropped_followers += 1
+                # the handler may be parked in the socket send (that IS the
+                # wedge) and won't drain this queue — clear it so the
+                # backlog is freed NOW; the sentinel is then next in line
+                # when TCP eventually errors the connection and the handler
+                # resumes (or closes via its finally)
+                while not q.empty():
+                    q.get_nowait()
+                q.put_nowait({"closed": True})
+                continue
             q.put_nowait(plan)
 
     def subscribe(self, host_index: int) -> asyncio.Queue:
@@ -143,10 +170,16 @@ class StepBroadcaster:
 
 
 class StepStreamHandler(AsyncEngine):
-    """Leader endpoint: one long-lived stream of step plans per follower."""
+    """Leader endpoint: one long-lived stream of step plans per follower.
 
-    def __init__(self, broadcaster: StepBroadcaster):
+    Idle gaps are filled with heartbeats so followers can distinguish "no
+    traffic" from "leader dead behind an open TCP connection" (a SIGKILLed
+    process closes its sockets; a dead HOST or partition does not)."""
+
+    def __init__(self, broadcaster: StepBroadcaster,
+                 heartbeat_interval_s: float = 2.0):
         self.broadcaster = broadcaster
+        self.heartbeat_interval_s = heartbeat_interval_s
 
     async def generate(
         self, request: Any, context: Context
@@ -157,7 +190,16 @@ class StepStreamHandler(AsyncEngine):
         try:
             yield {"hello": True}
             while True:
-                yield await queue.get()
+                try:
+                    msg = await asyncio.wait_for(
+                        queue.get(), timeout=self.heartbeat_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    yield {"hb": True}
+                    continue
+                yield msg
+                if msg.get("closed"):
+                    return  # broadcaster dropped this follower
         finally:
             self.broadcaster.unsubscribe(host_index)
             log.warning("follower %d disconnected", host_index)
@@ -216,17 +258,39 @@ async def follower_loop(
     component: str = "backend",
 ) -> None:
     """Connect to the leader's step stream, pass the barrier, replay plans
-    until the stream closes (leader death ⇒ the mesh is gone — exit so the
-    supervisor restarts the whole group)."""
+    until the stream closes OR goes silent past the heartbeat deadline
+    (leader death ⇒ the mesh is gone — exit so the supervisor restarts the
+    whole group; a partial group cannot re-elect, see MultihostConfig)."""
     client = await (
         runtime.namespace().component(component).endpoint("step_stream")
         .client()
     )
     await client.wait_for_instances(1, timeout_s=cfg.barrier_timeout_s)
     loop = asyncio.get_running_loop()
-    stream = client.round_robin({"host_index": cfg.host_index}, Context())
+    stream = client.round_robin(
+        {"host_index": cfg.host_index}, Context()
+    ).__aiter__()
     replayed = 0
-    async for msg in stream:
+    while True:
+        try:
+            msg = await asyncio.wait_for(
+                stream.__anext__(), timeout=cfg.heartbeat_timeout_s
+            )
+        except StopAsyncIteration:
+            log.warning("step stream closed after %d plans — leader gone,"
+                        " exiting", replayed)
+            return
+        except asyncio.TimeoutError:
+            log.error(
+                "no plan or heartbeat for %.0fs after %d plans — leader "
+                "presumed dead, exiting", cfg.heartbeat_timeout_s, replayed,
+            )
+            return
+        if msg.get("hb"):
+            continue
+        if msg.get("closed"):
+            log.error("leader dropped this follower (wedged) — exiting")
+            return
         if msg.get("hello"):
             await WorkerBarrier(
                 f"multihost/{name}", f"host-{cfg.host_index}",
@@ -242,5 +306,3 @@ async def follower_loop(
         if replayed == 1 or replayed % 1000 == 0:
             log.info("follower %d: %d plans replayed", cfg.host_index,
                      replayed)
-    log.warning("step stream closed after %d plans — leader gone, exiting",
-                replayed)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 from typing import Optional
 
 from .engine.config import EngineConfig, ModelConfig
@@ -94,8 +95,6 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="worker count the group leader waits for")
     # multi-host SPMD (one process per host of a slice; flags default to
     # the JAX_* env vars so TPU pod launchers can set them uniformly)
-    import os
-
     p.add_argument("--coordinator",
                    default=os.environ.get("JAX_COORDINATOR_ADDRESS"),
                    help="host0 ip:port for jax.distributed (multi-host)")
@@ -132,8 +131,6 @@ async def run_worker(args: argparse.Namespace) -> None:
     model_cfg = MODEL_PRESETS[args.model]()
     params = None
     if args.weights:
-        import os
-
         from .engine.weights import load_hf_params, model_config_from_hf
 
         if os.path.exists(os.path.join(args.weights, "config.json")):
@@ -168,9 +165,23 @@ async def run_worker(args: argparse.Namespace) -> None:
         try:
             await follower_loop(runtime, engine, mh, name,
                                 component=args.component)
+            log.warning("follower exiting (leader lost)")
+        except BaseException:
+            # the traceback must hit the log BEFORE the hard exit below
+            # discards it — a replay bug would otherwise masquerade as
+            # endless "leader lost" restarts
+            log.exception("follower loop terminated abnormally")
         finally:
-            await engine.stop()
-            await runtime.shutdown()
+            try:
+                await asyncio.wait_for(engine.stop(), timeout=10)
+                await asyncio.wait_for(runtime.shutdown(), timeout=10)
+            except BaseException:  # incl. CancelledError — must not skip
+                log.exception("follower cleanup failed")
+            # hard exit: jax.distributed's atexit barrier blocks forever
+            # when the coordinator host is gone, and the supervisor's
+            # restart contract needs a DEAD process, not a graceful-looking
+            # hang
+            os._exit(1)
         return
 
     if mh.enabled:
@@ -185,7 +196,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         step_ep = (runtime.namespace().component(args.component)
                    .endpoint("step_stream"))
         await step_ep.serve_endpoint(
-            StepStreamHandler(broadcaster),
+            StepStreamHandler(broadcaster,
+                              heartbeat_interval_s=mh.heartbeat_interval_s),
             advertise_host=args.advertise_host,
         )
         await leader_gate(runtime.store, mh, broadcaster, name)

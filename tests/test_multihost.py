@@ -131,3 +131,30 @@ async def test_multihost_follower_replays_all_steps(multihost_cluster):
     flog = multihost_cluster["follower"].log()
     assert "Traceback" not in flog
     assert "disconnected" not in multihost_cluster["leader"].log()
+
+
+async def test_leader_silent_death_releases_follower(multihost_cluster):
+    """A leader that goes silent behind an OPEN connection (SIGSTOP — the
+    dead-host/partition shape, no FIN ever arrives) must not hang the
+    follower: either our step-stream heartbeat deadline or jax.distributed's
+    coordination-service health check fires, and the follower process DIES
+    so a supervisor can restart the group."""
+    import signal
+
+    leader = multihost_cluster["leader"]
+    follower = multihost_cluster["follower"]
+    leader.proc.send_signal(signal.SIGSTOP)
+    try:
+        follower.wait_exit(60)
+    finally:
+        leader.proc.send_signal(signal.SIGCONT)
+
+
+async def test_leader_kill_releases_follower(multihost_cluster):
+    """SIGKILL closes the leader's sockets — the follower exits promptly
+    (stream EOF on the step stream, or the jax.distributed coordination
+    service declaring the group dead; both end in a dead process)."""
+    leader = multihost_cluster["leader"]
+    follower = multihost_cluster["follower"]
+    leader.kill()
+    follower.wait_exit(45)

@@ -80,6 +80,20 @@ class ManagedProcess:
             time.sleep(0.1)
         raise TimeoutError(f"{self.name}: {pattern!r} not seen:\n{self.log()}")
 
+    def wait_exit(self, timeout_s: float = 30.0) -> int:
+        """Wait for the process to die on its own (fault-injection tests);
+        raises with the log tail if it stays alive."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rc = self.proc.poll()
+            if rc is not None:
+                return rc
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"{self.name} still alive after {timeout_s}s:\n"
+            + self.log()[-2000:]
+        )
+
     # -- teardown --
 
     def terminate(self, timeout_s: float = 10.0) -> int:
