@@ -161,8 +161,15 @@ class StepBroadcaster:
         self._queues[host_index] = q
         return q
 
-    def unsubscribe(self, host_index: int) -> None:
-        self._queues.pop(host_index, None)
+    def unsubscribe(self, host_index: int,
+                    queue: Optional[asyncio.Queue] = None) -> None:
+        """With ``queue`` given, only remove if THAT queue is still the
+        registered one — a stale handler's teardown (wedged socket finally
+        erroring out) must not evict a restarted follower's fresh
+        subscription, which would starve it of plans while heartbeats keep
+        it looking alive."""
+        if queue is None or self._queues.get(host_index) is queue:
+            self._queues.pop(host_index, None)
 
     @property
     def num_followers(self) -> int:
@@ -201,7 +208,7 @@ class StepStreamHandler(AsyncEngine):
                 if msg.get("closed"):
                     return  # broadcaster dropped this follower
         finally:
-            self.broadcaster.unsubscribe(host_index)
+            self.broadcaster.unsubscribe(host_index, queue)
             log.warning("follower %d disconnected", host_index)
 
 

@@ -43,6 +43,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--min-endpoint", type=int, default=1)
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--decode-component", default="backend")
+    p.add_argument(
+        "--connector", default="virtual",
+        choices=["virtual", "kubernetes"],
+        help="virtual: write targets into the store (an orchestrator like "
+             "scale_watcher realises them); kubernetes: merge-patch the "
+             "TpuGraphDeployment CR in-cluster (ref: kubernetes_connector)",
+    )
+    p.add_argument("--k8s-deployment", default=None,
+                   help="TpuGraphDeployment name (default: the single CR "
+                        "in the pod's namespace)")
     return p.parse_args(argv)
 
 
@@ -56,6 +66,13 @@ async def run_planner(args: argparse.Namespace) -> None:
 
     with open(args.profile) as f:
         profile = json.load(f)
+    if args.connector == "kubernetes":
+        from .kubernetes_connector import KubernetesConnector
+
+        connector = KubernetesConnector(deployment_name=args.k8s_deployment)
+    else:
+        connector = VirtualConnector(runtime.store,
+                                     namespace=runtime.namespace().name)
     planner = Planner(
         PlannerConfig(
             ttft_sla_s=args.ttft,
@@ -68,8 +85,7 @@ async def run_planner(args: argparse.Namespace) -> None:
         ),
         PrefillInterpolator.from_profile(profile),
         DecodeInterpolator.from_profile(profile),
-        VirtualConnector(runtime.store,
-                         namespace=runtime.namespace().name),
+        connector,
         prefill_component=args.prefill_component,
         decode_component=args.decode_component,
     )
@@ -111,7 +127,12 @@ async def run_planner(args: argparse.Namespace) -> None:
     try:
         while True:
             await asyncio.sleep(args.adjustment_interval)
-            await planner.make_adjustments()
+            try:
+                await planner.make_adjustments()
+            except Exception:
+                # a transient connector failure (apiserver 5xx, network
+                # blip) must not kill the planner — next window retries
+                log.exception("adjustment failed — retrying next window")
     finally:
         ingest_task.cancel()
         await runtime.shutdown()

@@ -1,0 +1,167 @@
+"""Kubernetes scaling connector against a fake k8s API server
+(ref behavior: components/planner/src/dynamo/planner/kubernetes_connector.py
+— find the graph CR, merge-patch service replicas, skip mid-rollout)."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from dynamo_tpu.planner.kubernetes_connector import (
+    GROUP, PLURAL, VERSION, KubeConfig, KubernetesAPI, KubernetesConnector,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+class FakeKubeApi:
+    """Just enough of the apiserver: list/get/merge-patch one CRD."""
+
+    def __init__(self, namespace="prod"):
+        self.namespace = namespace
+        self.objects = {}
+        self.patches = []
+        self.auth_headers = []
+        self.clients = []  # KubernetesAPI instances to close at teardown
+        base = f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}/{PLURAL}"
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get(base, self._list),
+            web.get(base + "/{name}", self._get),
+            web.patch(base + "/{name}", self._patch),
+        ])
+        self.runner = None
+        self.port = None
+
+    async def start(self):
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+    def config(self) -> KubeConfig:
+        return KubeConfig(base_url=f"http://127.0.0.1:{self.port}",
+                          namespace=self.namespace, token="test-token")
+
+    async def _list(self, request):
+        self.auth_headers.append(request.headers.get("Authorization"))
+        return web.json_response({"items": list(self.objects.values())})
+
+    async def _get(self, request):
+        name = request.match_info["name"]
+        if name not in self.objects:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        return web.json_response(self.objects[name])
+
+    async def _patch(self, request):
+        name = request.match_info["name"]
+        assert (request.headers["Content-Type"]
+                == "application/merge-patch+json")
+        patch = json.loads(await request.text())
+        self.patches.append((name, patch))
+        obj = self.objects[name]
+        for svc, body in patch["spec"]["services"].items():
+            obj["spec"]["services"].setdefault(svc, {}).update(body)
+        return web.json_response(obj)
+
+
+def deployment(name="graph", ready=True, replicas=None):
+    replicas = replicas or {"backend": 1, "prefill": 1}
+    dep = {
+        "metadata": {"name": name},
+        "spec": {"services": {
+            svc: {"replicas": n} for svc, n in replicas.items()
+        }},
+        "status": {"conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"}
+        ]},
+    }
+    return dep
+
+
+@pytest.fixture
+async def fake_api():
+    api = FakeKubeApi()
+    await api.start()
+    yield api
+    for client in api.clients:
+        await client.close()
+    await api.stop()
+
+
+async def test_scale_patches_service_replicas(fake_api):
+    fake_api.objects["graph"] = deployment()
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api)
+    await conn.scale("backend", 3)
+    assert fake_api.patches == [
+        ("graph", {"spec": {"services": {"backend": {"replicas": 3}}}})
+    ]
+    assert await conn.read_target("backend") == 3
+    # bearer token rode every request
+    assert all(h == "Bearer test-token" for h in fake_api.auth_headers)
+
+
+async def test_scale_noop_when_already_at_target(fake_api):
+    fake_api.objects["graph"] = deployment()
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api)
+    await conn.scale("backend", 1)
+    assert fake_api.patches == []
+    assert conn.decision_count == 0
+
+
+async def test_scale_skipped_mid_rollout(fake_api):
+    fake_api.objects["graph"] = deployment(ready=False)
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api)
+    await conn.scale("backend", 5)
+    assert fake_api.patches == []  # guard: don't thrash an unsettled rollout
+
+
+async def test_unknown_component_rejected(fake_api):
+    fake_api.objects["graph"] = deployment()
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api)
+    with pytest.raises(ValueError, match="not in deployment"):
+        await conn.scale("nonexistent", 2)
+
+
+async def test_missing_deployment_raises(fake_api):
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api)
+    with pytest.raises(RuntimeError, match="not found"):
+        await conn.scale("backend", 2)
+    assert await conn.read_target("backend") is None
+
+
+async def test_named_deployment_selected_among_many(fake_api):
+    fake_api.objects["a"] = deployment("a", replicas={"backend": 1})
+    fake_api.objects["b"] = deployment("b", replicas={"backend": 2})
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    conn = KubernetesConnector(api, deployment_name="b")
+    assert await conn.read_target("backend") == 2
+    await conn.scale("backend", 4)
+    assert fake_api.patches[0][0] == "b"
+
+
+async def test_readiness_falls_back_to_status_services(fake_api):
+    dep = deployment()
+    dep["status"] = {"services": {"backend": {"replicas": 1},
+                                  "prefill": {"replicas": 1}}}
+    fake_api.objects["graph"] = dep
+    api = KubernetesAPI(fake_api.config())
+    fake_api.clients.append(api)
+    assert await api.is_ready(dep)
+    dep["status"]["services"]["backend"]["replicas"] = 0  # mid-rollout
+    assert not await api.is_ready(dep)
