@@ -422,6 +422,14 @@ class DecodeHandler(AsyncEngine):
         self, request: Any, context: Context
     ) -> AsyncIterator[dict]:
         token_ids = list(request["token_ids"])
+        if request.get("mm"):
+            # multimodal prompts prefill locally: the remote prefill path
+            # would need the embeddings shipped and spliced on the prefill
+            # worker (future work); local keeps EPD correctness
+            self.num_local_prefills += 1
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
         if not self._should_remote_prefill(token_ids):
             self.num_local_prefills += 1
             async for out in self.engine.generate(request, context):

@@ -46,6 +46,13 @@ class Request:
     seed: Optional[int] = None
     eos_token_ids: Tuple[int, ...] = ()
     ignore_eos: bool = False
+    # multimodal EPD: precomputed vision embeddings spliced over
+    # placeholder prompt positions, plus the content-addressed ids used
+    # for KV block hashing (never as model inputs) so the prefix cache
+    # can't serve one image's KV for another
+    mm_positions: Optional[List[int]] = None
+    mm_embeddings: Optional[np.ndarray] = None   # [len(mm_positions), D]
+    mm_hash_token_ids: Optional[List[int]] = None
 
 
 @dataclass
@@ -156,6 +163,15 @@ class EngineCore(AsyncEngine):
                 f"prompt length {len(request.token_ids)} exceeds "
                 f"max_model_len {self.config.max_model_len}"
             )
+        if (request.mm_positions
+                and getattr(self, "step_sink", None) is not None):
+            # admission-time rejection fails only THIS request; a raise in
+            # the step would abort every co-scheduled request, after parts
+            # of the batch were already replicated to followers
+            raise ValueError(
+                "multimodal prefill is not supported in multi-host "
+                "step-replication mode"
+            )
         seq = SchedSeq(
             seq_id=request.request_id or f"seq-{next(self._ids)}",
             prompt_ids=list(request.token_ids),
@@ -166,7 +182,31 @@ class EngineCore(AsyncEngine):
             top_k=request.top_k,
             top_p=request.top_p,
             seed=_seed31(request.seed),
+            mm_positions=(list(request.mm_positions)
+                          if request.mm_positions else None),
+            mm_embeddings=request.mm_embeddings,
         )
+        if request.mm_positions:
+            # content-addressed KV hashing: block hashes chain over ids
+            # that fold in the image content, so the prefix cache can't
+            # serve one image's KV for a prompt carrying another
+            from ..tokens import TokenBlockSequence
+
+            hash_ids = request.mm_hash_token_ids
+            if hash_ids is None or len(hash_ids) != len(request.token_ids):
+                raise ValueError(
+                    "multimodal requests need mm_hash_token_ids aligned "
+                    "with token_ids"
+                )
+            if (request.mm_embeddings is None
+                    or len(request.mm_embeddings)
+                    != len(request.mm_positions)):
+                raise ValueError(
+                    "mm_embeddings rows must match mm_positions"
+                )
+            seq.token_seq = TokenBlockSequence.from_tokens(
+                list(hash_ids), self.config.block_size
+            )
         if self.kvbm is not None:
             # promote host-tier prefix blocks into G1 before admission so
             # the scheduler's prefix match serves them as native hits;
@@ -174,9 +214,10 @@ class EngineCore(AsyncEngine):
             # scheduler (hash-chaining the prompt is O(prompt_len))
             from ..tokens import TokenBlockSequence
 
-            seq.token_seq = TokenBlockSequence.from_tokens(
-                seq.prompt_ids, self.config.block_size
-            )
+            if seq.token_seq is None:  # mm requests pre-built theirs
+                seq.token_seq = TokenBlockSequence.from_tokens(
+                    seq.prompt_ids, self.config.block_size
+                )
             try:
                 await self.kvbm.onboard_prefix(seq.token_seq)
             except Exception:
@@ -298,6 +339,12 @@ class EngineCore(AsyncEngine):
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         """Wire-format adapter: dict in, dict stream out."""
+        mm = request.get("mm") or {}
+        mm_embeddings = None
+        if mm:
+            from ..multimodal.encoder import array_from_wire
+
+            mm_embeddings = array_from_wire(mm["embeddings"])
         req = Request(
             request_id=context.id,
             token_ids=list(request["token_ids"]),
@@ -308,6 +355,9 @@ class EngineCore(AsyncEngine):
             seed=request.get("seed"),
             eos_token_ids=tuple(request.get("eos_token_ids", ())),
             ignore_eos=bool(request.get("ignore_eos", False)),
+            mm_positions=(list(mm["positions"]) if mm else None),
+            mm_embeddings=mm_embeddings,
+            mm_hash_token_ids=(list(mm["hash_token_ids"]) if mm else None),
         )
         async def _on_stop() -> None:
             await context.wait_stopped()
@@ -496,7 +546,9 @@ class InferenceEngine(EngineCore):
             model_config, engine_config, self.mesh
         )
         self._sp_prefill_fn = None
+        self._mm_prefill_fn = None  # built lazily on the first mm request
         self.num_sp_prefills = 0
+        self.num_mm_prefills = 0
         if (engine_config.sp_prefill_threshold > 0
                 and self.mesh.devices.size > 1):
             self._sp_prefill_fn = model_lib.make_sp_prefill_fn(
@@ -673,6 +725,7 @@ class InferenceEngine(EngineCore):
             self._sp_prefill_fn is not None
             and chunk.start == 0 and chunk.completes_prompt
             and chunk.length >= cfg.sp_prefill_threshold
+            and not seq.mm_positions  # the ring path has no mm splicing
         )
         if chunk.length <= max(cfg.prefill_buckets) and not use_sp:
             T = _bucket(chunk.length, cfg.prefill_buckets)
@@ -697,6 +750,35 @@ class InferenceEngine(EngineCore):
         top_k = np.array([seq.top_k], np.int32)
         top_p = np.array([seq.top_p], np.float32)
         seeds = np.array([seq.seed], np.int32)
+        # multimodal: placeholder rows inside this chunk take the encode
+        # worker's embeddings (decode never needs this — placeholders live
+        # in the prompt only)
+        mm_rows = []
+        if seq.mm_positions:
+            lo, hi = chunk.start, chunk.start + chunk.length
+            mm_rows = [
+                (p - lo, k) for k, p in enumerate(seq.mm_positions)
+                if lo <= p < hi
+            ]
+        if mm_rows:
+            if self._mm_prefill_fn is None:
+                self._mm_prefill_fn = model_lib.make_mm_prefill_fn(
+                    self.model_config, self.config, self.mesh
+                )
+            D = self.model_config.hidden_size
+            mm_embeds = np.zeros((1, T, D), np.float32)
+            mm_mask = np.zeros((1, T), bool)
+            emb = np.asarray(seq.mm_embeddings, np.float32)
+            for row, k in mm_rows:
+                mm_embeds[0, row] = emb[k]
+                mm_mask[0, row] = True
+            self.num_mm_prefills += 1
+            self.cache, sampled = self._mm_prefill_fn(
+                self.params, self.cache, tokens, positions, tables,
+                last_idx, self._next_rng(), temp, top_k, top_p, seeds,
+                mm_embeds, mm_mask,
+            )
+            return int(np.asarray(jax.device_get(sampled))[0])
         if self.step_sink is not None:
             self.step_sink("sp" if use_sp else "p", {
                 "tokens": tokens, "positions": positions, "tables": tables,

@@ -277,6 +277,8 @@ def forward(
     block_tables: jax.Array,  # [B, W] int32 physical block ids (0 = trash)
     mesh: Optional[Mesh] = None,
     ring_mesh: Optional[Mesh] = None,
+    mm_embeds: Optional[jax.Array] = None,  # [B, T, D] vision embeddings
+    mm_mask: Optional[jax.Array] = None,    # [B, T] True = use mm_embeds
 ) -> Tuple[Cache, jax.Array]:
     """Run the transformer over a token chunk, updating the paged cache.
 
@@ -299,6 +301,12 @@ def forward(
     use_ring = ring_mesh is not None and T > 1
 
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    if mm_embeds is not None:
+        # multimodal EPD: placeholder positions take the encode worker's
+        # precomputed embeddings instead of token embeddings (ref: the
+        # TRT-LLM EPD flow, request_handlers/handler_base.py:64-234 — the
+        # reference splices prompt embeddings the same way)
+        h = jnp.where(mm_mask[..., None], mm_embeds.astype(h.dtype), h)
     if use_ring:
         # pin activations T-sharded so the whole layer stack stays O(T/sp)
         h = jax.lax.with_sharding_constraint(
@@ -652,6 +660,35 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     params+cache carry their shardings from device_put; data args are small
     host arrays XLA replicates, so no explicit in_shardings are needed."""
     return jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,))
+
+
+def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                       mesh: Optional[Mesh]):
+    """Jitted multimodal prefill step: the regular unified step plus
+    ``mm_embeds [B, T, D]`` / ``mm_mask [B, T]`` splicing precomputed
+    vision embeddings over placeholder positions. Compiled lazily — only
+    engines that actually see multimodal requests pay for it; decode
+    never needs it (placeholders live in the prompt)."""
+
+    def step(params, cache, tokens, positions, block_tables,
+             last_idx, rng, temperature, top_k, top_p, seeds,
+             mm_embeds, mm_mask):
+        cache, h = forward(
+            cfg, eng, params, cache, tokens, positions, block_tables,
+            mesh=mesh, mm_embeds=mm_embeds, mm_mask=mm_mask,
+        )
+        B = tokens.shape[0]
+        h_last = h[jnp.arange(B), last_idx]
+        logits = logits_fn(cfg, params, h_last)
+        pos_last = jnp.take_along_axis(
+            positions, last_idx[:, None], axis=1
+        )[:, 0]
+        sampled = sample(
+            logits, rng, temperature, top_k, top_p, seeds, pos_last
+        )
+        return cache, sampled
+
+    return jax.jit(step, donate_argnums=(1,))
 
 
 def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):

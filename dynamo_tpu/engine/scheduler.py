@@ -202,6 +202,9 @@ class SchedSeq:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = -1          # -1 = unseeded (engine rng)
+    # multimodal: placeholder positions + their embedding rows [N, D]
+    mm_positions: Optional[list] = None
+    mm_embeddings: Optional[object] = None
     arrival: float = field(default_factory=time.monotonic)
     status: SeqStatus = SeqStatus.WAITING
     output_ids: List[int] = field(default_factory=list)

@@ -89,8 +89,26 @@ async def run_frontend(args: argparse.Namespace) -> None:
         sink = None
         if args.router_mode == "kv":
             sink, kv_routers[card.name] = await make_kv_sink(card, client)
+        tokenizer = card.load_tokenizer()  # shared by every pipeline piece
+        mm_processor = None
+        mm_cfg = (card.runtime_config or {}).get("multimodal")
+        if mm_cfg:
+            from ..multimodal.processor import MultimodalProcessor
+
+            encode_client = await (
+                runtime.namespace(entry["namespace"])
+                .component(mm_cfg["component"])
+                .endpoint(mm_cfg.get("endpoint", "encode")).client()
+            )
+            clients[card.name + "/encode"] = encode_client
+            mm_processor = MultimodalProcessor(
+                tokenizer,
+                tokens_per_image=int(mm_cfg["tokens_per_image"]),
+                encode_client=encode_client,
+            )
         engine = build_routed_pipeline(
             card, client, router_mode=args.router_mode, sink=sink,
+            mm_processor=mm_processor, tokenizer=tokenizer,
         )
         # embeddings ride the worker's encode-only "embed" endpoint; the
         # card advertises the capability (mocker-backed models don't have
@@ -102,7 +120,8 @@ async def run_frontend(args: argparse.Namespace) -> None:
                 .component(entry["component"]).endpoint("embed").client()
             )
             clients[card.name + "/embed"] = embed_client
-            embed_engine = EmbeddingsPipeline(card, embed_client)
+            embed_engine = EmbeddingsPipeline(card, embed_client,
+                                              tokenizer=tokenizer)
         manager.register(ModelEntry(
             name=card.name, engine=engine,
             chat="chat" in card.model_type,
@@ -126,6 +145,9 @@ async def run_frontend(args: argparse.Namespace) -> None:
         embed_client = clients.pop(name + "/embed", None)
         if embed_client:
             await embed_client.stop()
+        encode_client = clients.pop(name + "/encode", None)
+        if encode_client:
+            await encode_client.stop()
 
     watcher = ModelWatcher(runtime, on_add, on_remove)
     await watcher.start()

@@ -26,6 +26,11 @@ class Backend(Operator):
         req: PreprocessedRequest = request
         # engine-side stop set: model EOS + user stop_token_ids
         eos = set(req.stop.eos_token_ids) | set(req.stop.stop_token_ids)
+        if req.mm is not None:
+            return {**self._base_wire(req, eos), "mm": req.mm}
+        return self._base_wire(req, eos)
+
+    def _base_wire(self, req: PreprocessedRequest, eos) -> dict:
         return {
             "token_ids": req.token_ids,
             "model": req.model,

@@ -46,14 +46,25 @@ def build_routed_pipeline(
     *,
     router_mode: str = "round_robin",
     sink: Optional[AsyncEngine] = None,
+    mm_processor=None,
+    tokenizer=None,
 ) -> AsyncEngine:
-    """OpenAI dict in → BackendOutput stream out, over the cluster."""
-    tokenizer = card.load_tokenizer()
+    """OpenAI dict in → BackendOutput stream out, over the cluster.
+
+    ``mm_processor`` (multimodal.MultimodalProcessor) upgrades the
+    preprocessor to the encode-prefill-decode flow for requests carrying
+    image content parts. Pass ``tokenizer`` when the caller already loaded
+    it (loading twice per registration doubles model-add latency)."""
+    tokenizer = tokenizer or card.load_tokenizer()
     pre = Preprocessor(
         tokenizer,
         model_name=card.name,
         max_context_len=card.context_length,
     )
+    if mm_processor is not None:
+        from ..multimodal.processor import MultimodalPreprocessor
+
+        pre = MultimodalPreprocessor(pre, mm_processor)
     back = Backend(tokenizer)
     inner = sink or PushSink(client, router_mode)
     return link(pre, back, Migration(inner, card.migration_limit))

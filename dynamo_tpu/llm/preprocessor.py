@@ -88,6 +88,15 @@ class Preprocessor(Operator):
             return request
         req: dict = request
         token_ids, formatted = self._tokenize(req)
+        return self.build_request(req, token_ids, formatted=formatted)
+
+    def build_request(
+        self, req: dict, token_ids: List[int],
+        formatted: Optional[str] = None,
+    ) -> PreprocessedRequest:
+        """Assemble the PreprocessedRequest for already-produced token ids
+        (shared by the text path and the multimodal preprocessor so
+        sampling/stop/annotation semantics can never drift)."""
         if self.max_context_len and len(token_ids) >= self.max_context_len:
             raise ValueError(
                 f"prompt length {len(token_ids)} exceeds context window "

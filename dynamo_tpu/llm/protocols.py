@@ -69,9 +69,12 @@ class PreprocessedRequest:
     annotations: Dict[str, Any] = field(default_factory=dict)
     # router hints (ref: RouterConfigOverride kv_router.rs:87-93)
     router_hints: Dict[str, Any] = field(default_factory=dict)
+    # multimodal payload: {positions, embeddings (binary wire array),
+    # hash_token_ids} — see dynamo_tpu.multimodal
+    mm: Optional[Dict[str, Any]] = None
 
     def to_wire(self) -> dict:
-        return {
+        out = {
             "token_ids": self.token_ids,
             "model": self.model,
             "sampling": self.sampling.to_wire(),
@@ -79,6 +82,9 @@ class PreprocessedRequest:
             "annotations": self.annotations,
             "router_hints": self.router_hints,
         }
+        if self.mm is not None:
+            out["mm"] = self.mm
+        return out
 
     @staticmethod
     def from_wire(d: dict) -> "PreprocessedRequest":
@@ -89,6 +95,7 @@ class PreprocessedRequest:
             stop=StopConditions.from_wire(d.get("stop", {})),
             annotations=dict(d.get("annotations", {})),
             router_hints=dict(d.get("router_hints", {})),
+            mm=d.get("mm"),
         )
 
 

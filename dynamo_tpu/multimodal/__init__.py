@@ -1,0 +1,24 @@
+"""Multimodal encode-prefill-decode (EPD) support
+(ref: components/backends/trtllm — multimodal_processor.py + the EPD
+request handlers): a vision ENCODE worker turns media into prompt
+embeddings; prefill splices them over placeholder tokens; decode is
+unchanged. TPU-native: the encoder is one jitted patchify+transformer
+program, embeddings ride the wire as binary arrays, and KV block hashes
+are content-addressed over the media so the prefix cache can never serve
+one image's KV for another."""
+
+from .encoder import (
+    EncodeHandler, VisionEncoder, VisionEncoderConfig,
+    array_from_wire, array_to_wire,
+)
+from .processor import MM_MARKER, MultimodalProcessor
+
+__all__ = [
+    "EncodeHandler",
+    "VisionEncoder",
+    "VisionEncoderConfig",
+    "MultimodalProcessor",
+    "MM_MARKER",
+    "array_to_wire",
+    "array_from_wire",
+]

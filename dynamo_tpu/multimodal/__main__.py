@@ -1,0 +1,63 @@
+"""Standalone vision ENCODE worker — the E in EPD
+(ref: the TRT-LLM encode worker role). Serves the ``encode`` endpoint on
+its own component; language workers advertise it via
+``--mm-encode-component``.
+
+    python -m dynamo_tpu.multimodal --component encoder --model-dim 2048
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ..runtime.component import DistributedRuntime
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+from .encoder import EncodeHandler, VisionEncoder, VisionEncoderConfig
+
+log = get_logger("mm.worker")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu encode worker")
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--component", default="encoder")
+    p.add_argument("--advertise-host", default="127.0.0.1")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--patch-size", type=int, default=8)
+    p.add_argument("--model-dim", type=int, required=True,
+                   help="language model hidden size the embeddings target")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    config = RuntimeConfig.from_settings()
+    if args.store_addr:
+        config.store_addr = args.store_addr
+    if args.namespace:
+        config.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(config)
+
+    cfg = VisionEncoderConfig(
+        image_size=args.image_size, patch_size=args.patch_size,
+        model_dim=args.model_dim,
+    )
+    handler = EncodeHandler(VisionEncoder(cfg, seed=args.seed))
+    ep = (runtime.namespace().component(args.component).endpoint("encode"))
+    await ep.serve_endpoint(handler, advertise_host=args.advertise_host)
+    log.info(
+        "encode worker ready: %dx%d px -> %d tokens x %d dim",
+        cfg.image_size, cfg.image_size, cfg.tokens_per_image, cfg.model_dim,
+    )
+    await runtime.shutdown_event.wait()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

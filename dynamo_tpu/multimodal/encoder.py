@@ -1,0 +1,197 @@
+"""Vision encoder: image → prompt embeddings, as ONE jitted TPU program
+(role of the reference's encode worker in the TRT-LLM EPD flow — there a
+full vision tower inside the engine; here a compact ViT-style patchifier:
+conv-as-matmul patch embedding + a few pre-norm attention/MLP blocks +
+projection to the language model's hidden size, all MXU-friendly matmuls
+with static shapes).
+
+The encode worker serves this behind an ``encode`` endpoint; embeddings
+travel as raw binary arrays (`array_to_wire`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..utils.logging import get_logger
+
+log = get_logger("mm.encoder")
+
+
+# ----------------------------- wire codec ---------------------------------
+
+
+def array_to_wire(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"d": a.tobytes(), "t": a.dtype.str, "s": list(a.shape)}
+
+
+def array_from_wire(m: dict) -> np.ndarray:
+    return np.frombuffer(m["d"], np.dtype(m["t"])).reshape(m["s"]).copy()
+
+
+# ------------------------------ the model ---------------------------------
+
+
+@dataclass(frozen=True)
+class VisionEncoderConfig:
+    image_size: int = 32          # square inputs (resized by the processor)
+    patch_size: int = 8
+    channels: int = 3
+    width: int = 64               # encoder hidden size
+    num_layers: int = 2
+    num_heads: int = 4
+    model_dim: int = 64           # language model hidden size (projection)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.num_patches
+
+    @staticmethod
+    def tiny(model_dim: int = 64) -> "VisionEncoderConfig":
+        return VisionEncoderConfig(model_dim=model_dim)
+
+
+def init_vision_params(rng: jax.Array, cfg: VisionEncoderConfig) -> Dict:
+    p = cfg.patch_size
+    in_dim = p * p * cfg.channels
+    W, F = cfg.width, cfg.width * 4
+    keys = jax.random.split(rng, 10)
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(jnp.float32)
+
+    L = cfg.num_layers
+    return {
+        "patch": norm(keys[0], (in_dim, W), in_dim),
+        "pos": norm(keys[1], (cfg.num_patches, W), W),
+        "layers": {
+            "ln1": jnp.ones((L, W), jnp.float32),
+            "wqkv": norm(keys[2], (L, W, 3 * W), W),
+            "wo": norm(keys[3], (L, W, W), W),
+            "ln2": jnp.ones((L, W), jnp.float32),
+            "w1": norm(keys[4], (L, W, F), W),
+            "w2": norm(keys[5], (L, F, W), F),
+        },
+        "ln_f": jnp.ones((W,), jnp.float32),
+        "proj": norm(keys[6], (W, cfg.model_dim), W),
+    }
+
+
+def encode_image(cfg: VisionEncoderConfig, params: Dict,
+                 image: jax.Array) -> jax.Array:
+    """[H, W, C] float32 in [0, 1] → [num_patches, model_dim]."""
+    p = cfg.patch_size
+    n = cfg.image_size // p
+    H = cfg.num_heads
+    hd = cfg.width // H
+    # patchify: conv-as-matmul ([N, p*p*C] @ [p*p*C, W] rides the MXU)
+    x = image.reshape(n, p, n, p, cfg.channels)
+    x = x.transpose(0, 2, 1, 3, 4).reshape(n * n, p * p * cfg.channels)
+    h = x @ params["patch"] + params["pos"]              # [N, W]
+
+    def ln(x, w):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * w
+
+    stacked = params["layers"]
+    for li in range(cfg.num_layers):
+        lp = {k: v[li] for k, v in stacked.items()}
+        x = ln(h, lp["ln1"])
+        qkv = (x @ lp["wqkv"]).reshape(-1, 3, H, hd)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]        # [N, H, hd]
+        s = jnp.einsum("qhd,khd->hqk", q, k) / np.sqrt(hd)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hqk,khd->qhd", a, v).reshape(-1, cfg.width)
+        h = h + o @ lp["wo"]
+        x = ln(h, lp["ln2"])
+        h = h + jax.nn.gelu(x @ lp["w1"]) @ lp["w2"]
+    h = ln(h, params["ln_f"])
+    return h @ params["proj"]                            # [N, model_dim]
+
+
+class VisionEncoder:
+    """Jit-compiled encoder with deterministic params from a seed (the
+    language worker derives the same placeholder count from the config)."""
+
+    def __init__(self, config: VisionEncoderConfig, seed: int = 0):
+        self.config = config
+        self.params = init_vision_params(jax.random.PRNGKey(seed), config)
+        self._fn = jax.jit(lambda img: encode_image(config, self.params, img))
+        self.num_encoded = 0
+
+    def encode(self, image: np.ndarray) -> np.ndarray:
+        """[H, W, C] (any float/int dtype; resized/cropped by caller) →
+        [tokens_per_image, model_dim] float32."""
+        cfg = self.config
+        img = np.asarray(image, np.float32)
+        if img.ndim == 2:
+            img = np.repeat(img[:, :, None], cfg.channels, axis=2)
+        if img.shape != (cfg.image_size, cfg.image_size, cfg.channels):
+            img = _resize_nearest(
+                img, cfg.image_size, cfg.image_size, cfg.channels
+            )
+        if img.max() > 1.0 + 1e-6:
+            img = img / 255.0
+        out = np.asarray(jax.device_get(self._fn(jnp.asarray(img))))
+        self.num_encoded += 1
+        return out
+
+
+def _resize_nearest(img: np.ndarray, h: int, w: int, c: int) -> np.ndarray:
+    ys = (np.arange(h) * img.shape[0] / h).astype(int)
+    xs = (np.arange(w) * img.shape[1] / w).astype(int)
+    out = img[ys][:, xs]
+    if out.shape[2] > c:
+        out = out[:, :, :c]
+    elif out.shape[2] < c:
+        out = np.repeat(out[:, :, :1], c, axis=2)
+    return out
+
+
+class EncodeHandler(AsyncEngine):
+    """The encode worker's wire endpoint: images in, embeddings out
+    (served as ``encode`` next to the language worker's ``generate``).
+
+    Encoding is blocking jitted device work — it runs on a dedicated
+    executor thread so a colocated language worker's event loop keeps
+    pumping token streams while images encode."""
+
+    def __init__(self, encoder: VisionEncoder):
+        import concurrent.futures
+
+        self.encoder = encoder
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mm-encode"
+        )
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[dict]:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        embeddings = []
+        for img_wire in request.get("images", []):
+            out = await loop.run_in_executor(
+                self._executor, self.encoder.encode,
+                array_from_wire(img_wire),
+            )
+            embeddings.append(array_to_wire(out))
+        yield {
+            "embeddings": embeddings,
+            "tokens_per_image": self.encoder.config.tokens_per_image,
+        }

@@ -410,7 +410,12 @@ class KvPushRouter(AsyncEngine):
     async def generate(
         self, request: Any, context: Context
     ) -> AsyncIterator[Any]:
-        token_ids = list(request.get("token_ids", ()))
+        # multimodal prompts route by their CONTENT-ADDRESSED hash ids —
+        # the engine's KV events are keyed by those, while token_ids carry
+        # only placeholder runs that could never match
+        mm = request.get("mm") or {}
+        token_ids = list(mm.get("hash_token_ids")
+                         or request.get("token_ids", ()))
         hints: Dict[str, Any] = request.get("router_hints") or {}
         sel = self.router.find_best_match(
             context.id, token_ids,

@@ -30,6 +30,11 @@ class ServeOptions:
     migration_limit: int = 3
     tool_call_parser: Optional[str] = None
     reasoning_parser: Optional[str] = None
+    # multimodal EPD: advertisement for the card's runtime_config
+    # ({tokens_per_image, image_size, component, endpoint}) and an
+    # optional colocated encode handler to serve
+    mm: Optional[dict] = None
+    mm_handler: object = None
 
 
 async def serve_engine(
@@ -104,10 +109,24 @@ async def serve_engine(
                 target, lambda: health.status(target)
             )
 
+    if opts.mm_handler is not None:
+        mm_ep = (runtime.namespace().component(opts.component)
+                 .endpoint("encode"))
+        await mm_ep.serve_endpoint(
+            opts.mm_handler, advertise_host=opts.advertise_host
+        )
+
     if tokenizer is not None:
         model_type = ["chat", "completions"]
         if supports_embeddings:
             model_type.append("embeddings")
+        runtime_config = {
+            "total_kv_blocks": eng_cfg.num_blocks,
+            "max_num_seqs": eng_cfg.max_num_seqs,
+            "max_num_batched_tokens": eng_cfg.max_num_batched_tokens,
+        }
+        if opts.mm is not None:
+            runtime_config["multimodal"] = opts.mm
         card = ModelDeploymentCard(
             name=opts.name,
             model_type=model_type,
@@ -118,11 +137,7 @@ async def serve_engine(
             migration_limit=opts.migration_limit,
             eos_token_ids=list(tokenizer.eos_token_ids),
             bos_token_id=tokenizer.bos_token_id,
-            runtime_config={
-                "total_kv_blocks": eng_cfg.num_blocks,
-                "max_num_seqs": eng_cfg.max_num_seqs,
-                "max_num_batched_tokens": eng_cfg.max_num_batched_tokens,
-            },
+            runtime_config=runtime_config,
             tool_call_parser=opts.tool_call_parser,
             reasoning_parser=opts.reasoning_parser,
         )

@@ -93,6 +93,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default="worker")
     p.add_argument("--kvbm-group-size", type=int, default=1,
                    help="worker count the group leader waits for")
+    p.add_argument("--mm-encoder", action="store_true",
+                   help="serve a colocated vision encode endpoint and "
+                        "advertise multimodal support (EPD; a standalone "
+                        "encode worker is python -m dynamo_tpu.multimodal)")
+    p.add_argument("--mm-image-size", type=int, default=32)
+    p.add_argument("--mm-patch-size", type=int, default=8)
+    p.add_argument("--mm-encode-component", default=None,
+                   help="advertise a REMOTE encode worker's component "
+                        "instead of serving one here")
     # multi-host SPMD (one process per host of a slice; flags default to
     # the JAX_* env vars so TPU pod launchers can set them uniformly)
     p.add_argument("--coordinator",
@@ -285,12 +294,33 @@ async def run_worker(args: argparse.Namespace) -> None:
             store=runtime.store,
         )
 
+    mm_opts = None
+    mm_handler = None
+    if args.mm_encoder or args.mm_encode_component:
+        from .multimodal import (
+            EncodeHandler, VisionEncoder, VisionEncoderConfig,
+        )
+
+        vcfg = VisionEncoderConfig(
+            image_size=args.mm_image_size, patch_size=args.mm_patch_size,
+            model_dim=model_cfg.hidden_size,
+        )
+        mm_opts = {
+            "tokens_per_image": vcfg.tokens_per_image,
+            "image_size": vcfg.image_size,
+            "component": args.mm_encode_component or component,
+            "endpoint": "encode",
+        }
+        if not args.mm_encode_component:
+            mm_handler = EncodeHandler(VisionEncoder(vcfg))
+
     opts = ServeOptions(
         name=name, component=component, endpoint=args.endpoint,
         advertise_host=args.advertise_host,
         migration_limit=args.migration_limit,
         tool_call_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
+        mm=mm_opts, mm_handler=mm_handler,
     )
     served, kv_pub, metrics_pub = await serve_engine(
         runtime, engine, eng_cfg, opts, tokenizer, handler=handler
