@@ -119,6 +119,12 @@ class EngineConfig:
     # chains steps on device via lax.scan, amortising host↔device latency;
     # tokens past a sequence's EOS/capacity inside a window are discarded)
     decode_steps: int = 1
+    # pipeline parallelism: >1 runs the unified step GPipe-style over a
+    # ``pp`` mesh of that many stages (layers stage-sharded, decode
+    # batches microbatched; parallel/pp_serving.py). Mutually exclusive
+    # with (dp, tp) mesh_shape > (1, 1) and with decode_steps > 1.
+    pp_stages: int = 1
+    pp_microbatches: int = 4
     # sequence-parallel prefill: a fresh prompt at least this long is
     # prefilled as ONE chunk with its T axis sharded over all mesh devices
     # (ring attention over a flat "sp" view of the dp×tp device set), so
@@ -127,6 +133,8 @@ class EngineConfig:
     sp_prefill_threshold: int = 0
 
     def __post_init__(self):
+        if self.pp_stages > 1 and self.mesh_shape != (1, 1):
+            raise ValueError("pp_stages and a (dp, tp) mesh are exclusive")
         if self.max_num_seqs > max(self.decode_buckets):
             raise ValueError("max_num_seqs exceeds largest decode bucket")
         if self.max_num_batched_tokens > max(self.prefill_buckets):

@@ -163,15 +163,30 @@ class EngineCore(AsyncEngine):
                 f"prompt length {len(request.token_ids)} exceeds "
                 f"max_model_len {self.config.max_model_len}"
             )
-        if (request.mm_positions
-                and getattr(self, "step_sink", None) is not None):
+        if request.mm_positions:
             # admission-time rejection fails only THIS request; a raise in
-            # the step would abort every co-scheduled request, after parts
-            # of the batch were already replicated to followers
-            raise ValueError(
-                "multimodal prefill is not supported in multi-host "
-                "step-replication mode"
-            )
+            # the step would abort every co-scheduled request (and, for
+            # multi-host, after parts of the batch reached followers)
+            if getattr(self, "step_sink", None) is not None:
+                raise ValueError(
+                    "multimodal prefill is not supported in multi-host "
+                    "step-replication mode"
+                )
+            if getattr(self, "pp", 0) > 1:
+                raise ValueError(
+                    "multimodal prefill unsupported on a pipeline-parallel "
+                    "engine"
+                )
+            model_cfg = getattr(self, "model_config", None)
+            if (model_cfg is not None and request.mm_embeddings is not None
+                    and np.asarray(request.mm_embeddings).shape[-1]
+                    != model_cfg.hidden_size):
+                raise ValueError(
+                    f"mm embedding width "
+                    f"{np.asarray(request.mm_embeddings).shape[-1]} != "
+                    f"model hidden size {model_cfg.hidden_size} — is the "
+                    f"encode worker's --model-dim wrong?"
+                )
         seq = SchedSeq(
             seq_id=request.request_id or f"seq-{next(self._ids)}",
             prompt_ids=list(request.token_ids),
@@ -532,35 +547,62 @@ class InferenceEngine(EngineCore):
     ):
         super().__init__(engine_config)
         self.model_config = model_config
-        self.mesh = model_lib.make_mesh(engine_config.mesh_shape, devices)
+        self.pp = engine_config.pp_stages
         if params is None:
             params = model_lib.init_params(
                 jax.random.PRNGKey(seed), model_config
             )
-        self.params = model_lib.shard_params(params, self.mesh, model_config)
-        self.cache = model_lib.shard_cache(
-            model_lib.init_cache(model_config, engine_config), self.mesh,
-            model_config,
-        )
-        self._step_fn = model_lib.make_step_fn(
-            model_config, engine_config, self.mesh
-        )
         self._sp_prefill_fn = None
         self._mm_prefill_fn = None  # built lazily on the first mm request
+        self._multistep_fn = None
         self.num_sp_prefills = 0
         self.num_mm_prefills = 0
-        if (engine_config.sp_prefill_threshold > 0
-                and self.mesh.devices.size > 1):
-            self._sp_prefill_fn = model_lib.make_sp_prefill_fn(
+        if self.pp > 1:
+            # pipeline-parallel serving: layers stage-sharded over a pp
+            # mesh, stacked cache, GPipe-microbatched unified step
+            from ..parallel import pp_serving
+
+            self.mesh = pp_serving.make_pp_mesh(self.pp, devices)
+            self.params = jax.device_put(
+                params, pp_serving.pp_param_shardings(self.mesh,
+                                                      model_config)
+            )
+            self.cache = jax.device_put(
+                pp_serving.init_pp_cache(model_config, engine_config),
+                pp_serving.pp_cache_shardings(self.mesh, model_config),
+            )
+            self._step_fn = pp_serving.make_pp_step_fn(
+                model_config, engine_config, self.mesh,
+                engine_config.pp_microbatches,
+            )
+            if engine_config.decode_steps > 1:
+                log.warning("decode_steps > 1 is unsupported with "
+                            "pp_stages — running single-step decode")
+        else:
+            self.mesh = model_lib.make_mesh(
+                engine_config.mesh_shape, devices
+            )
+            self.params = model_lib.shard_params(
+                params, self.mesh, model_config
+            )
+            self.cache = model_lib.shard_cache(
+                model_lib.init_cache(model_config, engine_config),
+                self.mesh, model_config,
+            )
+            self._step_fn = model_lib.make_step_fn(
                 model_config, engine_config, self.mesh
             )
-            self.scheduler.sp_enabled = True
-        self._multistep_fn = None
-        if engine_config.decode_steps > 1:
-            self._multistep_fn = jax.jit(model_lib.raw_multistep_fn(
-                model_config, engine_config, engine_config.decode_steps,
-                self.mesh,
-            ), donate_argnums=(1,))
+            if (engine_config.sp_prefill_threshold > 0
+                    and self.mesh.devices.size > 1):
+                self._sp_prefill_fn = model_lib.make_sp_prefill_fn(
+                    model_config, engine_config, self.mesh
+                )
+                self.scheduler.sp_enabled = True
+            if engine_config.decode_steps > 1:
+                self._multistep_fn = jax.jit(model_lib.raw_multistep_fn(
+                    model_config, engine_config,
+                    engine_config.decode_steps, self.mesh,
+                ), donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._encode_fn = None  # built lazily on the first embed()
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -571,9 +613,14 @@ class InferenceEngine(EngineCore):
         # (parallel/multihost.py); called on the executor thread
         self.step_sink: Optional[Callable[[str, Dict[str, np.ndarray]],
                                           None]] = None
-        self._kv_extract, self._kv_inject = model_lib.make_kv_ops(
-            engine_config
-        )
+        if self.pp > 1:
+            # the transfer ops assume the per-layer list cache; disagg and
+            # KVBM on a pp engine are future work
+            self._kv_extract = self._kv_inject = None
+        else:
+            self._kv_extract, self._kv_inject = model_lib.make_kv_ops(
+                engine_config
+            )
 
     def _shutdown_executor(self) -> None:
         self._executor.shutdown(wait=False)
@@ -588,6 +635,9 @@ class InferenceEngine(EngineCore):
         hd]). The id list is padded to a power of two (pads gather the trash
         block) so XLA compiles O(log N) program variants, and the pad is
         sliced off."""
+        if self._kv_extract is None:
+            raise RuntimeError("KV block transfer unsupported on a "
+                               "pipeline-parallel engine")
         loop = asyncio.get_running_loop()
         n = len(block_ids)
         padded = np.zeros((_pow2_bucket(n),), np.int32)
@@ -607,6 +657,9 @@ class InferenceEngine(EngineCore):
     ) -> None:
         """Scatter per-block KV into physical blocks (pads scatter into the
         trash block, which absorbs garbage by design)."""
+        if self._kv_inject is None:
+            raise RuntimeError("KV block transfer unsupported on a "
+                               "pipeline-parallel engine")
         loop = asyncio.get_running_loop()
         n = len(block_ids)
         m = _pow2_bucket(n)
@@ -691,6 +744,9 @@ class InferenceEngine(EngineCore):
     def attach_kvbm(self, config=None, remote=None):
         """Enable the multi-tier block manager on this engine (optionally
         with a G4 remote tier)."""
+        if self.pp > 1:
+            raise RuntimeError("KVBM unsupported on a pipeline-parallel "
+                               "engine (stacked cache has no transfer ops)")
         from ..kvbm.manager import KvbmConfig, KvbmManager
 
         self.kvbm = KvbmManager(self, config or KvbmConfig(), remote=remote)

@@ -50,6 +50,18 @@ class VisionEncoderConfig:
     num_heads: int = 4
     model_dim: int = 64           # language model hidden size (projection)
 
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        if self.width % self.num_heads != 0:
+            raise ValueError(
+                f"width {self.width} not divisible by num_heads "
+                f"{self.num_heads}"
+            )
+
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
@@ -137,15 +149,18 @@ class VisionEncoder:
         """[H, W, C] (any float/int dtype; resized/cropped by caller) →
         [tokens_per_image, model_dim] float32."""
         cfg = self.config
+        # dtype decides normalisation — a value heuristic would leave a
+        # near-black uint8 image unscaled and encode it inconsistently
+        is_int = np.issubdtype(np.asarray(image).dtype, np.integer)
         img = np.asarray(image, np.float32)
+        if is_int:
+            img = img / 255.0
         if img.ndim == 2:
             img = np.repeat(img[:, :, None], cfg.channels, axis=2)
         if img.shape != (cfg.image_size, cfg.image_size, cfg.channels):
             img = _resize_nearest(
                 img, cfg.image_size, cfg.image_size, cfg.channels
             )
-        if img.max() > 1.0 + 1e-6:
-            img = img / 255.0
         out = np.asarray(jax.device_get(self._fn(jnp.asarray(img))))
         self.num_encoded += 1
         return out

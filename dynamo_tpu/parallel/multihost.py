@@ -97,20 +97,17 @@ def initialize_distributed(cfg: MultihostConfig) -> bool:
 # --------------------------- plan encoding -------------------------------
 
 
-def _enc(a: np.ndarray) -> dict:
-    return {"d": a.tobytes(), "t": a.dtype.str, "s": list(a.shape)}
-
-
-def _dec(m: dict) -> np.ndarray:
-    return np.frombuffer(m["d"], np.dtype(m["t"])).reshape(m["s"])
-
-
 def encode_plan(kind: str, arrays: Dict[str, np.ndarray]) -> dict:
-    return {"k": kind, "a": {n: _enc(v) for n, v in arrays.items()}}
+    from ..multimodal.encoder import array_to_wire
+
+    return {"k": kind,
+            "a": {n: array_to_wire(v) for n, v in arrays.items()}}
 
 
 def decode_plan(plan: dict):
-    return plan["k"], {n: _dec(v) for n, v in plan["a"].items()}
+    from ..multimodal.encoder import array_from_wire
+
+    return plan["k"], {n: array_from_wire(v) for n, v in plan["a"].items()}
 
 
 # ------------------------------ leader -----------------------------------

@@ -61,6 +61,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-batched-tokens", type=int, default=512)
     p.add_argument("--max-model-len", type=int, default=8192)
     p.add_argument("--mesh", default="1,1", help="dp,tp mesh axis sizes")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (layers stage-sharded, "
+                        "GPipe-microbatched decode; exclusive with --mesh)")
+    p.add_argument("--pp-microbatches", type=int, default=4)
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--advertise-host", default="127.0.0.1")
     p.add_argument(
@@ -154,6 +158,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         max_num_batched_tokens=args.max_batched_tokens,
         max_model_len=min(args.max_model_len, model_cfg.max_position),
         mesh_shape=(dp, tp),
+        pp_stages=args.pp,
+        pp_microbatches=args.pp_microbatches,
     )
     tokenizer = load_tokenizer(args.tokenizer)
     name = args.model_name or args.model
