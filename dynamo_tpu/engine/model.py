@@ -662,6 +662,137 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     return jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,))
 
 
+# ---------------- device-resident token ring (pipelined serving) ----------
+#
+# The serving hot loop must never wait on the host: on a remote-PJRT TPU
+# (this environment's tunnel) ONE host sync costs ~64 ms — 20× the 1B
+# model's 3 ms decode step — while enqueue-only dispatch costs ~0.3 ms.
+# The fix is architectural, not a kernel: keep the autoregressive token
+# feed ON DEVICE. ``last_tok`` is a small [S+1] int32 buffer indexed by a
+# per-sequence slot id; every prefill/decode step writes the token it
+# sampled into the sequence's slot, and decode windows READ their input
+# token from it. The host then only *observes* sampled tokens (fetched
+# asynchronously, one-plus windows behind) for detokenisation and stop
+# checks — it is never in the dispatch critical path. Slot S is a trash
+# slot (rows with slot -1 write there).
+#
+# Ref for the serving shape this replaces: the reference engine's
+# per-step host loop (components/backends/vllm — vLLM's GPU worker reads
+# sampled ids back every step; on GPU a sync is ~10 µs so it can afford
+# to). TPU-first redesign: same tokens, no sync.
+
+
+def raw_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
+                         mesh: Optional[Mesh] = None):
+    """K decode steps, UNROLLED, fed from the device token ring.
+
+    Unrolled rather than ``lax.scan``: the paged cache must not be a scan
+    carry (whole-cache copies every iteration — see ``init_cache``). K is
+    static; each iteration's scatter updates the donated cache in place.
+
+    Signature:
+      window(params, cache, last_tok[S+1], tok_host[B], tok_src[B],
+             slot_ids[B], positions[B,1], block_tables[B,W],
+             valid_until[B], rngs[K], temperature[B], top_k[B],
+             top_p[B], seeds[B])
+        -> (cache, last_tok, samples[K, B])
+
+    Input token per row: ``last_tok[slot]`` when ``tok_src > 0`` (the
+    previous window / prefill wrote it there — the host may not know it
+    yet), else ``tok_host`` (resumed / injected sequences). Rows whose
+    position reaches ``valid_until`` scatter to the trash block; their
+    garbage tokens are discarded by the scheduler. After the window, each
+    row's LAST VALID sample is written back to its slot so the next window
+    can chain without the host ever seeing a token.
+    """
+
+    def window(params, cache, last_tok, tok_host, tok_src, slot_ids,
+               positions, block_tables, valid_until, rngs,
+               temperature, top_k, top_p, seeds):
+        tok = jnp.where(tok_src > 0, last_tok[slot_ids], tok_host)[:, None]
+        pos = positions
+        outs = []
+        for k in range(K):
+            pos_eff = jnp.where(pos < valid_until[:, None], pos, -1)
+            cache, h = forward(
+                cfg, eng, params, cache, tok, pos_eff, block_tables,
+                mesh=mesh,
+            )
+            logits = logits_fn(cfg, params, h[:, 0])
+            s = sample(
+                logits, rngs[k], temperature, top_k, top_p, seeds,
+                pos[:, 0],
+            )
+            outs.append(s)
+            tok, pos = s[:, None], pos + 1
+        samples = jnp.stack(outs)                            # [K, B]
+        # write each row's last in-capacity sample back to its ring slot
+        acc = jnp.clip(valid_until - positions[:, 0], 1, K)  # [B]
+        final = jnp.take_along_axis(samples, (acc - 1)[None, :], axis=0)[0]
+        last_tok = last_tok.at[slot_ids].set(final)
+        return cache, last_tok, samples
+
+    return window
+
+
+def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
+                          mesh: Optional[Mesh] = None):
+    """Jitted ring decode window; cache and ring buffer donated."""
+    return jax.jit(
+        raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+    )
+
+
+def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                        mesh: Optional[Mesh] = None,
+                        ring_mesh: Optional[Mesh] = None):
+    """Unified prefill step that also posts its sampled token to the ring.
+
+    Same compute as ``raw_step_fn`` plus:
+      write_mask[B] (int32): rows completing their prompt write ``sampled``
+      into ``last_tok[slot]`` so the first decode window chains on device.
+    Non-completing chunks pass write_mask 0 (their sampled is discarded).
+
+    Signature:
+      prefill(params, cache, last_tok, tokens[B,T], positions[B,T],
+              block_tables[B,W], last_idx[B], slot_ids[B], write_mask[B],
+              rng, temperature[B], top_k[B], top_p[B], seeds[B])
+        -> (cache, last_tok, sampled[B])
+    """
+    base = raw_step_fn(cfg, eng, mesh, ring_mesh=ring_mesh)
+    trash = None  # resolved per-call from the ring size
+
+    def prefill(params, cache, last_tok, tokens, positions, block_tables,
+                last_idx, slot_ids, write_mask, rng,
+                temperature, top_k, top_p, seeds):
+        cache, sampled = base(
+            params, cache, tokens, positions, block_tables, last_idx,
+            rng, temperature, top_k, top_p, seeds,
+        )
+        S = last_tok.shape[0] - 1  # trash slot
+        slot_eff = jnp.where(write_mask > 0, slot_ids, S)
+        last_tok = last_tok.at[slot_eff].set(sampled)
+        return cache, last_tok, sampled
+
+    del trash
+    return prefill
+
+
+def make_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                         mesh: Optional[Mesh] = None,
+                         ring_mesh: Optional[Mesh] = None,
+                         out_shardings=None):
+    """Jitted ring prefill; cache + ring donated. ``out_shardings`` pins
+    the sp path's cache layout (see ``make_sp_prefill_fn``)."""
+    kw = {}
+    if out_shardings is not None:
+        kw["out_shardings"] = out_shardings
+    return jax.jit(
+        raw_ring_prefill_fn(cfg, eng, mesh, ring_mesh=ring_mesh),
+        donate_argnums=(1, 2), **kw,
+    )
+
+
 def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                        mesh: Optional[Mesh]):
     """Jitted multimodal prefill step: the regular unified step plus
@@ -691,6 +822,35 @@ def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     return jax.jit(step, donate_argnums=(1,))
 
 
+def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                            mesh: Optional[Mesh]):
+    """Ring-posting multimodal prefill (pipelined serving path): the mm
+    step plus the ``last_tok`` write of ``make_ring_prefill_fn``."""
+
+    def step(params, cache, last_tok, tokens, positions, block_tables,
+             last_idx, slot_ids, write_mask, rng,
+             temperature, top_k, top_p, seeds, mm_embeds, mm_mask):
+        cache, h = forward(
+            cfg, eng, params, cache, tokens, positions, block_tables,
+            mesh=mesh, mm_embeds=mm_embeds, mm_mask=mm_mask,
+        )
+        B = tokens.shape[0]
+        h_last = h[jnp.arange(B), last_idx]
+        logits = logits_fn(cfg, params, h_last)
+        pos_last = jnp.take_along_axis(
+            positions, last_idx[:, None], axis=1
+        )[:, 0]
+        sampled = sample(
+            logits, rng, temperature, top_k, top_p, seeds, pos_last
+        )
+        S = last_tok.shape[0] - 1
+        slot_eff = jnp.where(write_mask > 0, slot_ids, S)
+        last_tok = last_tok.at[slot_eff].set(sampled)
+        return cache, last_tok, sampled
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
 def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
     """Jitted full-prompt sequence-parallel prefill step.
 
@@ -709,6 +869,20 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
         raw_step_fn(cfg, eng, mesh, ring_mesh=sp_mesh),
         donate_argnums=(1,),
         out_shardings=out_shardings,
+    )
+
+
+def make_sp_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
+    """Ring-posting variant of the sp prefill (pipelined serving path)."""
+    devices = mesh.devices.flatten()
+    sp_mesh = Mesh(devices, ("sp",))
+    out_shardings = (
+        cache_shardings(mesh, cfg),
+        NamedSharding(mesh, P()),   # last_tok
+        NamedSharding(mesh, P()),   # sampled
+    )
+    return make_ring_prefill_fn(
+        cfg, eng, mesh, ring_mesh=sp_mesh, out_shardings=out_shardings
     )
 
 

@@ -1,0 +1,196 @@
+"""Device token ring: ring prefill + unrolled decode windows reproduce the
+synchronous step path token-for-token (greedy and seeded sampling), cap
+write-back, and trash-slot semantics. CPU, single device."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine import model as model_lib
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mc = ModelConfig.tiny()
+    ec = EngineConfig(
+        num_blocks=64, max_model_len=128, max_num_batched_tokens=32,
+        prefill_buckets=(32,), decode_buckets=(4,), max_num_seqs=4,
+    )
+    params = model_lib.init_params(jax.random.PRNGKey(0), mc)
+    return mc, ec, params
+
+
+def _prompt(n, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, size=n).astype(np.int32)
+
+
+def _sync_generate(mc, ec, params, prompt, n_decode, temperature=0.0,
+                   seed=-1):
+    """Reference: the synchronous unified-step path."""
+    step = model_lib.make_step_fn(mc, ec, None)
+    cache = model_lib.init_cache(mc, ec)
+    T = 32
+    bs = ec.block_size
+    table = list(range(1, 1 + (len(prompt) + n_decode) // bs + 2))
+    W = 8
+    tokens = np.zeros((1, T), np.int32)
+    positions = np.full((1, T), -1, np.int32)
+    tokens[0, :len(prompt)] = prompt
+    positions[0, :len(prompt)] = np.arange(len(prompt))
+    tables = np.zeros((1, W), np.int32)
+    tables[0, :len(table)] = table
+    temp = np.array([temperature], np.float32)
+    tk = np.zeros((1,), np.int32)
+    tp = np.ones((1,), np.float32)
+    sd = np.array([seed], np.int32)
+    rng = jax.random.PRNGKey(7)
+    cache, sampled = step(
+        params, cache, tokens, positions, tables,
+        np.array([len(prompt) - 1], np.int32), rng, temp, tk, tp, sd,
+    )
+    out = [int(np.asarray(sampled)[0])]
+    pos = len(prompt)
+    for i in range(n_decode - 1):
+        tok = np.array([[out[-1]]], np.int32)
+        rng, sub = jax.random.split(rng)
+        cache, sampled = step(
+            params, cache, tok, np.array([[pos]], np.int32), tables,
+            np.zeros((1,), np.int32), sub, temp, tk, tp, sd,
+        )
+        out.append(int(np.asarray(sampled)[0]))
+        pos += 1
+    return out
+
+
+def _ring_generate(mc, ec, params, prompt, n_decode, K, temperature=0.0,
+                   seed=-1):
+    """Ring path: ring prefill writes slot, decode windows chain on device.
+    The host feeds NO tokens after the prompt (tok_host=0, tok_src=1)."""
+    S = ec.max_num_seqs
+    prefill = model_lib.make_ring_prefill_fn(mc, ec, None)
+    window_fn = model_lib.make_decode_window_fn(mc, ec, K, None)
+    cache = model_lib.init_cache(mc, ec)
+    last_tok = jnp.zeros((S + 1,), jnp.int32)
+    T = 32
+    bs = ec.block_size
+    table = list(range(1, 1 + (len(prompt) + n_decode) // bs + 2))
+    W = 8
+    tokens = np.zeros((1, T), np.int32)
+    positions = np.full((1, T), -1, np.int32)
+    tokens[0, :len(prompt)] = prompt
+    positions[0, :len(prompt)] = np.arange(len(prompt))
+    tables = np.zeros((1, W), np.int32)
+    tables[0, :len(table)] = table
+    temp = np.array([temperature], np.float32)
+    tk = np.zeros((1,), np.int32)
+    tp = np.ones((1,), np.float32)
+    sd = np.array([seed], np.int32)
+    slot = np.array([2], np.int32)   # arbitrary live slot
+    rng = jax.random.PRNGKey(7)
+    cache, last_tok, sampled = prefill(
+        params, cache, last_tok, tokens, positions, tables,
+        np.array([len(prompt) - 1], np.int32), slot,
+        np.ones((1,), np.int32), rng, temp, tk, tp, sd,
+    )
+    out = [int(np.asarray(sampled)[0])]
+    assert int(np.asarray(last_tok)[2]) == out[0]
+    pos = len(prompt)
+    remaining = n_decode - 1
+    while remaining > 0:
+        rng, sub = jax.random.split(rng)
+        rngs = jax.random.split(sub, K)[::1]
+        # keep per-step rng identical to the sync path: the sync loop
+        # splits once per step; here we split once per step too by
+        # chaining — only meaningful for unseeded stochastic rows, which
+        # this test does not assert token-exactness for
+        cache, last_tok, samples = window_fn(
+            params, cache, last_tok,
+            np.zeros((1,), np.int32),          # tok_host unused
+            np.ones((1,), np.int32),           # tok_src = ring
+            slot, np.array([[pos]], np.int32), tables,
+            np.full((1,), ec.max_model_len, np.int32), rngs,
+            temp, tk, tp, sd,
+        )
+        got = np.asarray(samples)[:, 0]
+        take = min(K, remaining)
+        out.extend(int(t) for t in got[:take])
+        pos += take
+        remaining -= take
+    return out
+
+
+def test_ring_matches_sync_greedy(setup):
+    mc, ec, params = setup
+    prompt = _prompt(12, mc.vocab_size)
+    ref = _sync_generate(mc, ec, params, prompt, 9)
+    for K in (1, 4):
+        got = _ring_generate(mc, ec, params, prompt, 9, K)
+        assert got == ref, (K, got, ref)
+
+
+def test_ring_matches_sync_seeded(setup):
+    """Seeded stochastic rows are position-keyed, so the ring path must
+    reproduce the sync path exactly even with temperature > 0."""
+    mc, ec, params = setup
+    prompt = _prompt(10, mc.vocab_size, seed=3)
+    ref = _sync_generate(mc, ec, params, prompt, 8, temperature=0.8,
+                         seed=1234)
+    got = _ring_generate(mc, ec, params, prompt, 8, K=4, temperature=0.8,
+                         seed=1234)
+    assert got == ref
+
+
+def test_window_capacity_writeback(setup):
+    """Rows at capacity write their LAST VALID sample to the ring, not the
+    garbage computed past valid_until."""
+    mc, ec, params = setup
+    K = 4
+    window_fn = model_lib.make_decode_window_fn(mc, ec, K, None)
+    cache = model_lib.init_cache(mc, ec)
+    S = ec.max_num_seqs
+    last_tok = jnp.zeros((S + 1,), jnp.int32)
+    B, W = 4, 8
+    tables = np.tile(np.arange(1, W + 1, dtype=np.int32), (B, 1))
+    pos0 = 10
+    # row 0: only 2 of 4 steps fit (valid_until = pos0 + 2)
+    vu = np.array([pos0 + 2, 128, 128, 128], np.int32)
+    slots = np.arange(B, dtype=np.int32)
+    rngs = jax.random.split(jax.random.PRNGKey(0), K)
+    cache, last_tok, samples = window_fn(
+        params, cache, last_tok,
+        np.full((B,), 5, np.int32), np.zeros((B,), np.int32), slots,
+        np.full((B, 1), pos0, np.int32), tables, vu, rngs,
+        np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+        np.ones((B,), np.float32), np.full((B,), -1, np.int32),
+    )
+    samples = np.asarray(samples)
+    lt = np.asarray(last_tok)
+    assert lt[0] == samples[1, 0]      # capped at 2 accepted steps
+    assert lt[1] == samples[K - 1, 1]  # full window
+
+
+def test_trash_slot(setup):
+    """slot -1 → writes land in the trash slot; live slots unaffected."""
+    mc, ec, params = setup
+    window_fn = model_lib.make_decode_window_fn(mc, ec, 2, None)
+    cache = model_lib.init_cache(mc, ec)
+    S = ec.max_num_seqs
+    last_tok = jnp.full((S + 1,), 77, jnp.int32)
+    B, W = 4, 8
+    tables = np.tile(np.arange(1, W + 1, dtype=np.int32), (B, 1))
+    slots = np.array([0, S, S, S], np.int32)  # rows 1-3 disowned
+    rngs = jax.random.split(jax.random.PRNGKey(0), 2)
+    cache, last_tok, samples = window_fn(
+        params, cache, last_tok,
+        np.full((B,), 5, np.int32), np.zeros((B,), np.int32), slots,
+        np.full((B, 1), 4, np.int32), tables,
+        np.full((B,), 128, np.int32), rngs,
+        np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+        np.ones((B,), np.float32), np.full((B,), -1, np.int32),
+    )
+    lt = np.asarray(last_tok)
+    assert lt[0] == np.asarray(samples)[1, 0]
+    assert all(lt[i] == 77 for i in range(1, S))  # untouched live slots
