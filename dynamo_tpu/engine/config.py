@@ -115,10 +115,18 @@ class EngineConfig:
     # with online softmax (ops/paged_attention.py); "einsum" materialises the
     # gathered context (the XLA-fusion reference path)
     attention_impl: str = "pallas"
-    # tokens generated per device roundtrip in decode-only rounds (>1
-    # chains steps on device via lax.scan, amortising host↔device latency;
-    # tokens past a sequence's EOS/capacity inside a window are discarded)
+    # tokens generated per decode window (>1 chains steps on device via an
+    # UNROLLED window fed from the device token ring, amortising the
+    # host↔device roundtrip; tokens past a sequence's EOS/capacity inside
+    # a window are discarded)
     decode_steps: int = 1
+    # run-ahead: how many scheduled windows may be in flight before the
+    # engine loop waits for a landing. >1 dispatches window N+1 (decode
+    # input tokens read from the device ring) while window N's sampled
+    # tokens are still being fetched — on a remote-PJRT TPU one sync is
+    # ~64 ms vs a ~3 ms decode step, so the sync must never sit on the
+    # dispatch path. 1 = classic synchronous loop (pp engines force 1).
+    pipeline_depth: int = 2
     # pipeline parallelism: >1 runs the unified step GPipe-style over a
     # ``pp`` mesh of that many stages (layers stage-sharded, decode
     # batches microbatched; parallel/pp_serving.py). Mutually exclusive

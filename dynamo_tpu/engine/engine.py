@@ -15,8 +15,11 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import itertools
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+from typing import (
+    Any, AsyncIterator, Callable, Deque, Dict, List, Optional, Tuple,
+)
 
 import jax
 import numpy as np
@@ -117,6 +120,11 @@ class EngineCore(AsyncEngine):
         self.kv_event_sink: Optional[Callable[[dict], None]] = None
         self._pending_events: List[dict] = []
         self.kvbm = None  # multi-tier block manager (attach_kvbm)
+        # run-ahead depth: how many scheduled windows may be in flight
+        # before the loop waits for a landing. 1 = classic synchronous
+        # schedule→execute→postprocess. The JAX engine raises this (device
+        # dispatch is async; host syncs are ~64 ms on remote-PJRT TPUs).
+        self.pipeline_depth = 1
         # counters
         self.num_generated_tokens = 0
         self.num_steps = 0
@@ -403,6 +411,108 @@ class EngineCore(AsyncEngine):
         raise NotImplementedError
 
     async def _run_loop(self) -> None:
+        if self.pipeline_depth > 1:
+            await self._run_loop_pipelined()
+        else:
+            await self._run_loop_sync()
+
+    async def _run_loop_pipelined(self) -> None:
+        """Run-ahead loop: schedule and dispatch window N+1 while window N
+        is still computing/fetching. Decode input tokens ride the device
+        token ring, so no dispatch ever waits on a host fetch; sampled
+        tokens are observed one-plus windows behind for emission and stop
+        checks. Landings are applied strictly in dispatch order."""
+        inflight: Deque[Tuple[Any, Any]] = deque()
+
+        async def land_next() -> None:
+            batch0, fut = inflight.popleft()
+            try:
+                results = await fut
+            except Exception:
+                log.exception("window failed; aborting its seqs")
+                self._abort_batch(batch0)
+                return
+            try:
+                self._postprocess(batch0, results)
+            except Exception:
+                log.exception("postprocess failed")
+            self._flush_kv_events()
+
+        while not self._stopped:
+            while inflight and inflight[0][1].done():
+                await land_next()
+            batch = self.scheduler.schedule()
+            if batch.is_empty:
+                if inflight:
+                    await land_next()
+                    continue
+                if self.scheduler.waiting and not self.scheduler.running:
+                    seq = self.scheduler.waiting[0]
+                    log.error("seq %s cannot fit in KV pool — failing",
+                              seq.seq_id)
+                    self.scheduler.abort(seq, "error")
+                    self._emit_finish(seq, "error")
+                    continue
+                self._wake.clear()
+                if self.kvbm is not None:
+                    try:
+                        while (not self._wake.is_set()
+                               and await self.kvbm.tick()):
+                            pass
+                    except Exception:
+                        log.exception("kvbm idle drain failed")
+                if self._stopped:
+                    break
+                await self._wake.wait()
+                continue
+            try:
+                fut = await self._dispatch_batch_async(batch)
+            except Exception:
+                log.exception("dispatch failed; aborting scheduled seqs")
+                self._abort_batch(batch)
+                continue
+            inflight.append((batch, fut))
+            while len(inflight) >= self.pipeline_depth:
+                await land_next()
+            if self.kvbm is not None:
+                try:
+                    await self.kvbm.tick()
+                except Exception:
+                    log.exception("kvbm offload tick failed")
+        while inflight:  # drain so stop() leaves consistent bookkeeping
+            await land_next()
+
+    def _abort_batch(self, batch) -> None:
+        """Fail every seq a dispatched-or-dispatching batch touches and
+        clear the speculative pendings it registered."""
+        for chunk in batch.prefills:
+            seq = chunk.seq
+            self.scheduler.on_tokens_discarded(
+                seq, 0, first=chunk.final, prompt=chunk.length
+            )
+            if seq.status != SeqStatus.FINISHED:
+                self.scheduler.abort(seq, "error")
+                self._emit_finish(seq, "error")
+        for row in batch.decode_rows:
+            seq = row.seq
+            self.scheduler.on_tokens_discarded(seq, row.accepted)
+            if seq.status != SeqStatus.FINISHED:
+                self.scheduler.abort(seq, "error")
+                self._emit_finish(seq, "error")
+
+    async def _dispatch_batch_async(self, batch):
+        """Enqueue the batch's device work; resolve to a future of fetched
+        results. Overridden by the JAX engine; the base class executes
+        synchronously (mocker paths keep pipeline_depth 1)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        try:
+            fut.set_result(await self._execute_batch_async(batch))
+        except Exception as e:  # pragma: no cover
+            fut.set_exception(e)
+        return fut
+
+    async def _run_loop_sync(self) -> None:
         while not self._stopped:
             batch = self.scheduler.schedule()
             if batch.is_empty:
@@ -433,12 +543,10 @@ class EngineCore(AsyncEngine):
                 results = await self._execute_batch_async(batch)
             except Exception:
                 log.exception("engine step failed; aborting scheduled seqs")
-                for chunk in batch.prefills:
-                    self.scheduler.abort(chunk.seq, "error")
-                    self._emit_finish(chunk.seq, "error")
-                for seq in batch.decodes:
-                    self.scheduler.abort(seq, "error")
-                    self._emit_finish(seq, "error")
+                # _abort_batch also clears the speculative pendings that
+                # schedule() registered — plain abort would park the seqs
+                # as never-reaped zombies, leaking blocks and ring slots
+                self._abort_batch(batch)
                 continue
             try:
                 self._postprocess(batch, results)
@@ -455,29 +563,38 @@ class EngineCore(AsyncEngine):
 
     def _postprocess(self, batch, results) -> None:
         """Apply step results. Decode samples are per-seq token WINDOWS
-        (length >= 1); tokens after a mid-window finish are discarded."""
+        (length >= 1); tokens after a mid-window finish are discarded (and
+        their speculative pendings cleared so zombie seqs get reaped)."""
         prefill_samples, decode_samples = results
         self.num_steps += 1
         for chunk, sampled in zip(batch.prefills, prefill_samples):
             seq = chunk.seq
             if seq.status == SeqStatus.FINISHED:
-                continue  # aborted while the step was in flight
-            # capture before on_prefill_executed appends the sampled token
-            # (which grows total_tokens and would flip the property)
-            completed = chunk.completes_prompt
+                # aborted while the chunk was in flight
+                self.scheduler.on_tokens_discarded(
+                    seq, 0, first=chunk.final, prompt=chunk.length
+                )
+                continue
             self.scheduler.on_prefill_executed(
-                chunk, sampled if completed else None
+                chunk, sampled if chunk.final else None
             )
-            if completed:
+            if chunk.final:
                 self._emit_token(seq)
-        for seq, window in zip(batch.decodes, decode_samples):
+        rows = batch.decode_rows
+        for i, seq in enumerate(batch.decodes):
+            window = decode_samples[i]
             if isinstance(window, int):
                 window = [window]
-            for tok in window:
+            accepted = rows[i].accepted if i < len(rows) else len(window)
+            applied = 0
+            for tok in window[:accepted]:
                 if seq.status == SeqStatus.FINISHED:
                     break  # aborted / stopped mid-window
                 self.scheduler.on_decode_executed(seq, tok)
+                applied += 1
                 self._emit_token(seq)
+            if applied < accepted:
+                self.scheduler.on_tokens_discarded(seq, accepted - applied)
 
     def _emit_token(self, seq: SchedSeq) -> None:
         self.num_generated_tokens += 1
@@ -554,7 +671,6 @@ class InferenceEngine(EngineCore):
             )
         self._sp_prefill_fn = None
         self._mm_prefill_fn = None  # built lazily on the first mm request
-        self._multistep_fn = None
         self.num_sp_prefills = 0
         self.num_mm_prefills = 0
         if self.pp > 1:
@@ -592,21 +708,39 @@ class InferenceEngine(EngineCore):
             self._step_fn = model_lib.make_step_fn(
                 model_config, engine_config, self.mesh
             )
+            # pipelined serving path: ring-posting prefill + unrolled
+            # decode windows fed from the device token ring
+            self._ring_prefill_fn = model_lib.make_ring_prefill_fn(
+                model_config, engine_config, self.mesh
+            )
+            self._window_K = max(1, engine_config.decode_steps)
+            self._decode_window_fn = model_lib.make_decode_window_fn(
+                model_config, engine_config, self._window_K, self.mesh
+            )
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._last_tok = jax.device_put(
+                np.zeros((engine_config.max_num_seqs + 1,), np.int32),
+                NamedSharding(self.mesh, PartitionSpec()),
+            )
+            self.pipeline_depth = max(1, engine_config.pipeline_depth)
             if (engine_config.sp_prefill_threshold > 0
                     and self.mesh.devices.size > 1):
-                self._sp_prefill_fn = model_lib.make_sp_prefill_fn(
+                self._sp_prefill_fn = model_lib.make_sp_ring_prefill_fn(
                     model_config, engine_config, self.mesh
                 )
                 self.scheduler.sp_enabled = True
-            if engine_config.decode_steps > 1:
-                self._multistep_fn = jax.jit(model_lib.raw_multistep_fn(
-                    model_config, engine_config,
-                    engine_config.decode_steps, self.mesh,
-                ), donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._encode_fn = None  # built lazily on the first embed()
+        self._mm_ring_fn = None  # lazy (pipelined mm prefill)
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
+        )
+        # fetches (device_get of sampled-token handles) run OFF the
+        # dispatch thread: a fetch is a host sync (~64 ms+ on remote-PJRT)
+        # and must never delay the next window's enqueue. Two workers so
+        # one slow fetch doesn't convoy the next landing.
+        self._fetch_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="tpu-fetch"
         )
         # multi-host: the leader's broadcaster observes every executed step
         # so followers can replay the identical jitted call sequence
@@ -624,6 +758,7 @@ class InferenceEngine(EngineCore):
 
     def _shutdown_executor(self) -> None:
         self._executor.shutdown(wait=False)
+        self._fetch_exec.shutdown(wait=False)
 
     # ------------------ KV block transfer (disagg) ---------------------
     # Both run on the single step executor thread, serialising them with
@@ -760,29 +895,70 @@ class InferenceEngine(EngineCore):
             self._executor, self._execute_batch, batch
         )
 
+    async def _dispatch_batch_async(self, batch):
+        """Pipelined path: enqueue the batch's jitted calls on the dispatch
+        thread (no sync), then hand the sampled-token handles to a fetch
+        worker. Returns the asyncio future of the fetched results."""
+        loop = asyncio.get_running_loop()
+        handles = await loop.run_in_executor(
+            self._executor, self._dispatch_batch, batch
+        )
+        return loop.run_in_executor(
+            self._fetch_exec, self._fetch_results, batch, handles
+        )
+
     def _execute_batch(self, batch) -> Tuple[List[int], List[int]]:
-        """Runs on the executor thread: build arrays, dispatch jitted steps."""
+        """Synchronous execution (pipeline_depth=1 / pp engines): dispatch
+        then fetch in one executor turn."""
+        if self.pp == 1:
+            return self._fetch_results(batch, self._dispatch_batch(batch))
         prefill_samples: List[int] = []
         for chunk in batch.prefills:
             prefill_samples.append(self._run_prefill(chunk))
-        decode_samples: List[int] = []
+        decode_samples: List[List[int]] = []
         if batch.decodes:
-            decode_samples = self._run_decode(batch.decodes)
+            decode_samples = self._run_decode(batch)
+        return prefill_samples, decode_samples
+
+    def _dispatch_batch(self, batch):
+        """Executor thread: build arrays + enqueue every jitted call for
+        this window. NO host sync anywhere in here."""
+        prefill_handles = [
+            self._dispatch_prefill(c) for c in batch.prefills
+        ]
+        decode_handle = (
+            self._dispatch_decode(batch.decode_rows)
+            if batch.decode_rows else None
+        )
+        return prefill_handles, decode_handle
+
+    def _fetch_results(self, batch, handles):
+        """Fetch thread: device_get the window's sampled tokens (the only
+        host↔device sync in the serving loop) and unpack per seat."""
+        prefill_handles, decode_handle = handles
+        to_get = list(prefill_handles)
+        if decode_handle is not None:
+            to_get.append(decode_handle)
+        got = jax.device_get(to_get) if to_get else []
+        prefill_samples = [
+            int(np.asarray(g)[0]) for g in got[:len(prefill_handles)]
+        ]
+        decode_samples: List[List[int]] = []
+        if decode_handle is not None:
+            out = np.asarray(got[-1])  # [K, B]
+            for i, row in enumerate(batch.decode_rows):
+                decode_samples.append(
+                    [int(out[k, i]) for k in range(row.accepted)]
+                )
         return prefill_samples, decode_samples
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _run_prefill(self, chunk: PrefillChunk) -> int:
+    def _prefill_arrays(self, chunk: PrefillChunk, use_sp: bool):
         cfg = self.config
         seq = chunk.seq
-        use_sp = (
-            self._sp_prefill_fn is not None
-            and chunk.start == 0 and chunk.completes_prompt
-            and chunk.length >= cfg.sp_prefill_threshold
-            and not seq.mm_positions  # the ring path has no mm splicing
-        )
         if chunk.length <= max(cfg.prefill_buckets) and not use_sp:
             T = _bucket(chunk.length, cfg.prefill_buckets)
         else:
@@ -801,27 +977,52 @@ class InferenceEngine(EngineCore):
         )
         tables = np.zeros((1, W), np.int32)
         tables[0, :len(seq.block_table)] = seq.block_table
-        last_idx = np.array([chunk.length - 1], np.int32)
-        temp = np.array([seq.temperature], np.float32)
-        top_k = np.array([seq.top_k], np.int32)
-        top_p = np.array([seq.top_p], np.float32)
-        seeds = np.array([seq.seed], np.int32)
-        # multimodal: placeholder rows inside this chunk take the encode
-        # worker's embeddings (decode never needs this — placeholders live
-        # in the prompt only)
-        mm_rows = []
-        if seq.mm_positions:
-            lo, hi = chunk.start, chunk.start + chunk.length
-            mm_rows = [
-                (p - lo, k) for k, p in enumerate(seq.mm_positions)
-                if lo <= p < hi
-            ]
+        return {
+            "tokens": tokens, "positions": positions, "tables": tables,
+            "last_idx": np.array([chunk.length - 1], np.int32),
+            "temp": np.array([seq.temperature], np.float32),
+            "top_k": np.array([seq.top_k], np.int32),
+            "top_p": np.array([seq.top_p], np.float32),
+            "seeds": np.array([seq.seed], np.int32),
+        }
+
+    def _mm_chunk_rows(self, chunk: PrefillChunk):
+        """(chunk-relative row, embedding index) of multimodal placeholder
+        positions inside this chunk (decode never needs this — placeholders
+        live in the prompt only)."""
+        seq = chunk.seq
+        if not seq.mm_positions:
+            return []
+        lo, hi = chunk.start, chunk.start + chunk.length
+        return [
+            (p - lo, k) for k, p in enumerate(seq.mm_positions)
+            if lo <= p < hi
+        ]
+
+    def _dispatch_prefill(self, chunk: PrefillChunk):
+        """Enqueue one prefill chunk on the ring path; returns the sampled
+        handle [1] (garbage unless ``chunk.final``). No host sync."""
+        cfg = self.config
+        seq = chunk.seq
+        use_sp = (
+            self._sp_prefill_fn is not None
+            and chunk.start == 0 and chunk.final
+            and chunk.length >= cfg.sp_prefill_threshold
+            and not seq.mm_positions  # the sp path has no mm splicing
+        )
+        a = self._prefill_arrays(chunk, use_sp)
+        slot = np.array(
+            [seq.slot if seq.slot >= 0 else cfg.max_num_seqs], np.int32
+        )
+        write = np.array([1 if chunk.final else 0], np.int32)
+        mm_rows = self._mm_chunk_rows(chunk)
         if mm_rows:
-            if self._mm_prefill_fn is None:
-                self._mm_prefill_fn = model_lib.make_mm_prefill_fn(
-                    self.model_config, self.config, self.mesh
+            if self._mm_ring_fn is None:
+                self._mm_ring_fn = model_lib.make_mm_ring_prefill_fn(
+                    self.model_config, cfg, self.mesh
                 )
             D = self.model_config.hidden_size
+            T = a["tokens"].shape[1]
             mm_embeds = np.zeros((1, T, D), np.float32)
             mm_mask = np.zeros((1, T), bool)
             emb = np.asarray(seq.mm_embeddings, np.float32)
@@ -829,32 +1030,119 @@ class InferenceEngine(EngineCore):
                 mm_embeds[0, row] = emb[k]
                 mm_mask[0, row] = True
             self.num_mm_prefills += 1
+            self.cache, self._last_tok, sampled = self._mm_ring_fn(
+                self.params, self.cache, self._last_tok, a["tokens"],
+                a["positions"], a["tables"], a["last_idx"], slot, write,
+                self._next_rng(), a["temp"], a["top_k"], a["top_p"],
+                a["seeds"], mm_embeds, mm_mask,
+            )
+            return sampled
+        if self.step_sink is not None:
+            self.step_sink("rsp" if use_sp else "rp",
+                           {**a, "slot": slot, "write": write})
+        step = self._sp_prefill_fn if use_sp else self._ring_prefill_fn
+        if use_sp:
+            self.num_sp_prefills += 1
+        self.cache, self._last_tok, sampled = step(
+            self.params, self.cache, self._last_tok, a["tokens"],
+            a["positions"], a["tables"], a["last_idx"], slot, write,
+            self._next_rng(), a["temp"], a["top_k"], a["top_p"],
+            a["seeds"],
+        )
+        return sampled
+
+    def _dispatch_decode(self, rows) -> jax.Array:
+        """Enqueue one ring decode window; returns the samples handle
+        [K, B]. Input tokens come from the device ring for rows whose
+        producer hasn't landed yet. No host sync."""
+        cfg = self.config
+        B = _bucket(len(rows), cfg.decode_buckets)
+        W = _pow2_bucket(
+            max(len(r.seq.block_table) for r in rows),
+            cfg.max_blocks_per_seq,
+        )
+        trash_slot = cfg.max_num_seqs
+        tok_host = np.zeros((B,), np.int32)
+        tok_src = np.zeros((B,), np.int32)
+        slots = np.full((B,), trash_slot, np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        tables = np.zeros((B, W), np.int32)
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seeds = np.full((B,), -1, np.int32)
+        valid_until = np.zeros((B,), np.int32)
+        for i, r in enumerate(rows):
+            s = r.seq
+            tok_host[i] = r.tok_host
+            tok_src[i] = r.tok_src
+            slots[i] = r.slot if r.slot >= 0 else trash_slot
+            positions[i, 0] = r.base
+            tables[i, :len(s.block_table)] = s.block_table
+            temp[i] = s.temperature
+            top_k[i] = s.top_k
+            top_p[i] = s.top_p
+            seeds[i] = s.seed
+            # scatter guard: block capacity and model length; tokens past
+            # the cap go to the trash block and are discarded on landing
+            valid_until[i] = min(len(s.block_table) * cfg.block_size,
+                                 cfg.max_model_len)
+        if self.step_sink is not None:
+            self.step_sink("w", {
+                "tok_host": tok_host, "tok_src": tok_src, "slots": slots,
+                "positions": positions, "tables": tables,
+                "valid_until": valid_until, "temp": temp, "top_k": top_k,
+                "top_p": top_p, "seeds": seeds,
+            })
+        rngs = jax.random.split(self._next_rng(), self._window_K)
+        self.cache, self._last_tok, samples = self._decode_window_fn(
+            self.params, self.cache, self._last_tok, tok_host, tok_src,
+            slots, positions, tables, valid_until, rngs, temp, top_k,
+            top_p, seeds,
+        )
+        return samples
+
+    # ---- legacy synchronous path (pipeline-parallel engines only) ----
+
+    def _run_prefill(self, chunk: PrefillChunk) -> int:
+        a = self._prefill_arrays(chunk, use_sp=False)
+        mm_rows = self._mm_chunk_rows(chunk)
+        if mm_rows:
+            if self._mm_prefill_fn is None:
+                self._mm_prefill_fn = model_lib.make_mm_prefill_fn(
+                    self.model_config, self.config, self.mesh
+                )
+            D = self.model_config.hidden_size
+            T = a["tokens"].shape[1]
+            mm_embeds = np.zeros((1, T, D), np.float32)
+            mm_mask = np.zeros((1, T), bool)
+            emb = np.asarray(chunk.seq.mm_embeddings, np.float32)
+            for row, k in mm_rows:
+                mm_embeds[0, row] = emb[k]
+                mm_mask[0, row] = True
+            self.num_mm_prefills += 1
             self.cache, sampled = self._mm_prefill_fn(
-                self.params, self.cache, tokens, positions, tables,
-                last_idx, self._next_rng(), temp, top_k, top_p, seeds,
-                mm_embeds, mm_mask,
+                self.params, self.cache, a["tokens"], a["positions"],
+                a["tables"], a["last_idx"], self._next_rng(), a["temp"],
+                a["top_k"], a["top_p"], a["seeds"], mm_embeds, mm_mask,
             )
             return int(np.asarray(jax.device_get(sampled))[0])
         if self.step_sink is not None:
-            self.step_sink("sp" if use_sp else "p", {
-                "tokens": tokens, "positions": positions, "tables": tables,
-                "last_idx": last_idx, "temp": temp, "top_k": top_k,
-                "top_p": top_p, "seeds": seeds,
-            })
-        step = self._sp_prefill_fn if use_sp else self._step_fn
-        if use_sp:
-            self.num_sp_prefills += 1
-        self.cache, sampled = step(
-            self.params, self.cache, tokens, positions, tables,
-            last_idx, self._next_rng(), temp, top_k, top_p, seeds,
+            self.step_sink("p", {**a})
+        self.cache, sampled = self._step_fn(
+            self.params, self.cache, a["tokens"], a["positions"],
+            a["tables"], a["last_idx"], self._next_rng(), a["temp"],
+            a["top_k"], a["top_p"], a["seeds"],
         )
         return int(np.asarray(jax.device_get(sampled))[0])
 
-    def _run_decode(self, seqs: List[SchedSeq]) -> List[List[int]]:
+    def _run_decode(self, batch) -> List[List[int]]:
         cfg = self.config
-        B = _bucket(len(seqs), cfg.decode_buckets)
+        rows = batch.decode_rows
+        B = _bucket(len(rows), cfg.decode_buckets)
         W = _pow2_bucket(
-            max(len(s.block_table) for s in seqs), cfg.max_blocks_per_seq
+            max(len(r.seq.block_table) for r in rows),
+            cfg.max_blocks_per_seq,
         )
         tokens = np.zeros((B, 1), np.int32)
         positions = np.full((B, 1), -1, np.int32)
@@ -863,41 +1151,15 @@ class InferenceEngine(EngineCore):
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         seeds = np.full((B,), -1, np.int32)
-        valid_until = np.zeros((B,), np.int32)
-        accepted = []
-        K = cfg.decode_steps
-        for i, s in enumerate(seqs):
-            tokens[i, 0] = s.all_tokens()[s.num_computed]
-            positions[i, 0] = s.num_computed
+        for i, r in enumerate(rows):
+            s = r.seq
+            tokens[i, 0] = r.tok_host
+            positions[i, 0] = r.base
             tables[i, :len(s.block_table)] = s.block_table
             temp[i] = s.temperature
             top_k[i] = s.top_k
             top_p[i] = s.top_p
             seeds[i] = s.seed
-            # window capped by block capacity and model length; tokens past
-            # the cap scatter to trash on device and are discarded here
-            cap = min(len(s.block_table) * cfg.block_size,
-                      cfg.max_model_len)
-            valid_until[i] = cap
-            accepted.append(max(1, min(K, cap - s.num_computed)))
-        if self._multistep_fn is not None:
-            if self.step_sink is not None:
-                self.step_sink("m", {
-                    "tokens": tokens, "positions": positions,
-                    "tables": tables, "valid_until": valid_until,
-                    "temp": temp, "top_k": top_k,
-                    "top_p": top_p, "seeds": seeds,
-                })
-            rngs = jax.random.split(self._next_rng(), K)
-            self.cache, sampled = self._multistep_fn(
-                self.params, self.cache, tokens, positions, tables,
-                valid_until, rngs, temp, top_k, top_p, seeds,
-            )
-            out = np.asarray(jax.device_get(sampled))   # [K, B]
-            return [
-                [int(out[k, i]) for k in range(accepted[i])]
-                for i in range(len(seqs))
-            ]
         last_idx = np.zeros((B,), np.int32)
         if self.step_sink is not None:
             self.step_sink("d", {
@@ -910,4 +1172,4 @@ class InferenceEngine(EngineCore):
             last_idx, self._next_rng(), temp, top_k, top_p, seeds,
         )
         out = np.asarray(jax.device_get(sampled))
-        return [[int(out[i])] for i in range(len(seqs))]
+        return [[int(out[i])] for i in range(len(rows))]

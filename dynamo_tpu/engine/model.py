@@ -760,7 +760,6 @@ def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         -> (cache, last_tok, sampled[B])
     """
     base = raw_step_fn(cfg, eng, mesh, ring_mesh=ring_mesh)
-    trash = None  # resolved per-call from the ring size
 
     def prefill(params, cache, last_tok, tokens, positions, block_tables,
                 last_idx, slot_ids, write_mask, rng,
@@ -774,7 +773,6 @@ def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         last_tok = last_tok.at[slot_eff].set(sampled)
         return cache, last_tok, sampled
 
-    del trash
     return prefill
 
 

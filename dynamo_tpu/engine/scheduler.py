@@ -217,6 +217,17 @@ class SchedSeq:
     # disagg: keep blocks alive after finish until the KV is extracted
     # (prefill worker side; released via Scheduler.release_held)
     hold_blocks: bool = False
+    # ---- pipelined (run-ahead) serving state ----
+    # device token-ring slot (-1 = unassigned); see model.raw_decode_window_fn
+    slot: int = -1
+    # dispatched-but-unlanded work (speculative scheduling reads through it)
+    pending_prompt: int = 0   # prefill chunk tokens in flight
+    pending_first: int = 0    # 1 while the prompt-completing sample is in flight
+    pending_decode: int = 0   # decode tokens in flight
+
+    @property
+    def pending_total(self) -> int:
+        return self.pending_prompt + self.pending_first + self.pending_decode
 
     @property
     def total_tokens(self) -> int:
@@ -240,6 +251,9 @@ class PrefillChunk:
     seq: SchedSeq
     start: int  # first token index in this chunk
     length: int
+    # snapshot of completes_prompt at schedule time (the live property is
+    # unstable once pipelined decode windows append outputs)
+    final: bool = False
 
     @property
     def completes_prompt(self) -> bool:
@@ -250,9 +264,23 @@ class PrefillChunk:
 
 
 @dataclass
+class DecodeRow:
+    """One decode seat in a window, snapshotted at schedule time (the seq's
+    live fields may run ahead by the time the window lands)."""
+
+    seq: SchedSeq
+    base: int        # input position (num_computed seen through pendings)
+    accepted: int    # tokens this window contributes (<= decode_steps)
+    tok_host: int    # input token when the host knows it, else 0
+    tok_src: int     # 1 = read the device ring, 0 = tok_host
+    slot: int
+
+
+@dataclass
 class ScheduledBatch:
     prefills: List[PrefillChunk] = field(default_factory=list)
     decodes: List[SchedSeq] = field(default_factory=list)
+    decode_rows: List[DecodeRow] = field(default_factory=list)
     preempted: List[SchedSeq] = field(default_factory=list)
 
     @property
@@ -282,6 +310,13 @@ class Scheduler:
         self.waiting: Deque[SchedSeq] = deque()
         self.running: List[SchedSeq] = []
         self.stats = SchedulerStats(num_total_blocks=config.num_blocks - 1)
+        # device token-ring slots (pipelined serving); slot max_num_seqs is
+        # the trash slot and is never handed out
+        self._free_slots: Deque[int] = deque(range(config.max_num_seqs))
+        # finished seqs with windows still in flight: blocks + slot live
+        # until the engine reaps them (a landed window may still scatter
+        # into their blocks)
+        self.zombies: List[SchedSeq] = []
         # set by the engine once it has actually built an sp prefill step —
         # config alone isn't enough (a single-device mesh can't ring), and
         # emitting a whole-prompt chunk the engine must run densely would
@@ -311,17 +346,36 @@ class Scheduler:
 
         # 1. decodes: every running sequence advances up to ``decode_steps``
         # tokens per round (multi-token windows amortise the host↔device
-        # roundtrip; capacity is reserved for the whole window up front)
+        # roundtrip; capacity is reserved for the whole window up front).
+        # Scheduling reads *through* in-flight work (pending_*): a window
+        # can be planned before the previous one lands, with the input
+        # token fed from the device ring (run-ahead pipelining).
         window = max(1, self.config.decode_steps)
         for seq in list(self.running):
             if budget <= 0:
                 break
             if seq.status is not SeqStatus.RUNNING:
                 continue  # preempted by an earlier seq's _ensure_slot
-            last_pos = min(seq.num_computed + window,
-                           self.config.max_model_len) - 1
-            if not self._ensure_slot(seq, last_pos, batch):
-                continue  # seq itself was preempted
+            base = seq.num_computed + seq.pending_prompt + seq.pending_decode
+            quota = seq.max_tokens - (
+                len(seq.output_ids) + seq.pending_first + seq.pending_decode
+            )
+            accepted = min(window, quota, self.config.max_model_len - base)
+            if accepted <= 0:
+                continue  # a length-finish is landing; nothing to add
+            if seq.slot < 0:
+                if not self._free_slots:
+                    continue  # all slots zombie-held; retry after reaping
+                seq.slot = self._free_slots.popleft()
+            if not self._ensure_slot(seq, base + accepted - 1, batch):
+                continue  # seq was preempted (or is pinned by pendings)
+            tok_src = 1 if (seq.pending_first or seq.pending_decode) else 0
+            tok_host = 0 if tok_src else seq.all_tokens()[base]
+            batch.decode_rows.append(DecodeRow(
+                seq=seq, base=base, accepted=accepted,
+                tok_host=tok_host, tok_src=tok_src, slot=seq.slot,
+            ))
+            seq.pending_decode += accepted
             budget -= 1
             batch.decodes.append(seq)
 
@@ -340,11 +394,17 @@ class Scheduler:
             if seq.status == SeqStatus.WAITING:
                 self._match_prefix(seq)
                 seq.status = SeqStatus.PREFILL
+            if seq.slot < 0:
+                if not self._free_slots:
+                    break  # all slots zombie-held; admit after reaping
+                seq.slot = self._free_slots.popleft()
             target = seq.total_tokens  # prompt (+ outputs when recomputing)
-            remaining = target - seq.num_computed
+            # schedule *through* chunks still in flight (pipelined prefill)
+            start = seq.num_computed + seq.pending_prompt
+            remaining = target - start
             sp_thresh = self.config.sp_prefill_threshold
             sp_intent = (self.sp_enabled and sp_thresh
-                         and seq.num_computed == 0
+                         and start == 0
                          and remaining >= sp_thresh)
             if sp_intent:
                 # sequence-parallel prefill: the whole fresh prompt goes as
@@ -355,19 +415,19 @@ class Scheduler:
                 # chunk ≤ budget, so a partial chunk always exhausts the
                 # budget and the loop cannot schedule a token range twice
                 chunk = min(budget, remaining)
-            # blocks needed to hold [num_computed, num_computed + chunk)
+            # blocks needed to hold [start, start + chunk)
             have = len(seq.block_table)
-            need = (seq.num_computed + chunk + bs - 1) // bs - have
+            need = (start + chunk + bs - 1) // bs - have
             if not self._can_allocate(need):
                 # shrink the chunk to what fits above the watermark
-                chunk = self._max_affordable_chunk(seq, chunk)
+                chunk = self._max_affordable_chunk(seq, chunk, start)
                 if sp_intent and chunk < remaining:
                     # can't host the full prompt → it can't ring; fall back
                     # to budgeted chunking rather than a giant dense chunk
                     chunk = min(budget, chunk)
                 if chunk <= 0:
                     break  # pool exhausted; try again next step
-                need = (seq.num_computed + chunk + bs - 1) // bs - have
+                need = (start + chunk + bs - 1) // bs - have
             ok = True
             for _ in range(need):
                 bid = self.pool.allocate()
@@ -377,11 +437,15 @@ class Scheduler:
                 seq.block_table.append(bid)
             if not ok:
                 break
+            final = start + chunk >= target
             batch.prefills.append(
-                PrefillChunk(seq=seq, start=seq.num_computed, length=chunk)
+                PrefillChunk(seq=seq, start=start, length=chunk,
+                             final=final)
             )
+            seq.pending_prompt += chunk
             budget -= chunk
-            if seq.num_computed + chunk >= target:
+            if final:
+                seq.pending_first = 1
                 self.waiting.popleft()
                 self.running.append(seq)
                 seq.status = SeqStatus.RUNNING
@@ -395,14 +459,44 @@ class Scheduler:
                             sampled: Optional[int]) -> None:
         seq = chunk.seq
         seq.num_computed += chunk.length
+        seq.pending_prompt = max(0, seq.pending_prompt - chunk.length)
         self._seal_complete_blocks(seq)
-        if chunk.completes_prompt and sampled is not None:
+        if chunk.final and sampled is not None:
+            seq.pending_first = 0
             self._append_token(seq, sampled)
 
     def on_decode_executed(self, seq: SchedSeq, sampled: int) -> None:
         seq.num_computed += 1
+        seq.pending_decode = max(0, seq.pending_decode - 1)
         self._seal_complete_blocks(seq)
         self._append_token(seq, sampled)
+
+    def on_tokens_discarded(self, seq: SchedSeq, n: int,
+                            first: bool = False, prompt: int = 0) -> None:
+        """A landed window carried ``n`` decode tokens (plus optionally a
+        prefill chunk / the prompt-completing sample) that were NOT
+        applied — the seq finished or was aborted mid-flight. Clears their
+        pendings and reaps the seq once nothing references its blocks/slot
+        anymore."""
+        if n:
+            seq.pending_decode = max(0, seq.pending_decode - n)
+        if prompt:
+            seq.pending_prompt = max(0, seq.pending_prompt - prompt)
+        if first:
+            seq.pending_first = 0
+        if (seq.status == SeqStatus.FINISHED and seq.pending_total == 0
+                and seq in self.zombies):
+            self.reap(seq)
+
+    def reap(self, seq: SchedSeq) -> None:
+        """Release a finished seq's blocks and ring slot once no in-flight
+        window can touch them."""
+        if seq in self.zombies:
+            self.zombies.remove(seq)
+        if not seq.hold_blocks:
+            self._release_blocks(seq)
+        self._free_slot(seq)
+        self._refresh_stats()
 
     def finish(self, seq: SchedSeq, reason: str) -> None:
         self._finish(seq, reason)
@@ -471,21 +565,37 @@ class Scheduler:
             if bid is not None:
                 seq.block_table.append(bid)
                 continue
-            victim = self._pick_victim()
+            victim = self._pick_victim(seq)
             if victim is None or victim is seq:
+                if seq.pending_total > 0:
+                    # in-flight windows still scatter into this seq's
+                    # blocks — recompute-preemption would corrupt them.
+                    # Skip this round; landing windows free capacity.
+                    return False
                 self._preempt(seq, batch)
                 return False
+            # victims always have pending_total == 0, so they can never be
+            # in this batch's decode rows (rows set pending_decode at
+            # planning time) — no batch cleanup needed
             self._preempt(victim, batch)
-            if victim in batch.decodes:
-                batch.decodes.remove(victim)
         return True
 
-    def _pick_victim(self) -> Optional[SchedSeq]:
-        return self.running[-1] if self.running else None
+    def _pick_victim(self, requester: SchedSeq) -> Optional[SchedSeq]:
+        # LIFO, but a seq with in-flight windows is unpreemptible: freeing
+        # its blocks while a dispatched window scatters into them corrupts
+        # whichever seq the pool hands them to next
+        for cand in reversed(self.running):
+            if cand is requester:
+                continue
+            if cand.pending_total == 0:
+                return cand
+        return None
 
     def _preempt(self, seq: SchedSeq, batch: ScheduledBatch) -> None:
+        assert seq.pending_total == 0, "preempting a seq with inflight work"
         log.info("preempting seq %s (recompute)", seq.seq_id)
         self._release_blocks(seq)
+        self._free_slot(seq)
         seq.num_computed = 0
         seq.num_sealed_blocks = 0
         seq.preemptions += 1
@@ -500,15 +610,27 @@ class Scheduler:
             self.pool.decref(bid)
         seq.block_table = []
 
+    def _free_slot(self, seq: SchedSeq) -> None:
+        if seq.slot >= 0:
+            self._free_slots.append(seq.slot)
+            seq.slot = -1
+
     def _finish(self, seq: SchedSeq, reason: str) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
-        if not seq.hold_blocks:
-            self._release_blocks(seq)
         if seq in self.running:
             self.running.remove(seq)
         if seq in self.waiting:
             self.waiting.remove(seq)
+        if seq.pending_total > 0:
+            # in-flight windows still scatter into these blocks; the engine
+            # reaps via on_tokens_discarded once they land
+            if seq not in self.zombies:
+                self.zombies.append(seq)
+        else:
+            if not seq.hold_blocks:
+                self._release_blocks(seq)
+            self._free_slot(seq)
         self._refresh_stats()
 
     def release_held(self, seq: SchedSeq) -> None:
@@ -555,7 +677,8 @@ class Scheduler:
         watermark_blocks = self.config.watermark * (self.config.num_blocks - 1)
         return self.pool.num_free - need >= watermark_blocks
 
-    def _max_affordable_chunk(self, seq: SchedSeq, want: int) -> int:
+    def _max_affordable_chunk(self, seq: SchedSeq, want: int,
+                              start: Optional[int] = None) -> int:
         bs = self.config.block_size
         watermark_blocks = int(
             self.config.watermark * (self.config.num_blocks - 1)
@@ -563,7 +686,9 @@ class Scheduler:
         affordable = self.pool.num_free - watermark_blocks
         if affordable <= 0:
             return 0
-        have_capacity = len(seq.block_table) * bs - seq.num_computed
+        if start is None:
+            start = seq.num_computed + seq.pending_prompt
+        have_capacity = len(seq.block_table) * bs - start
         return min(want, have_capacity + affordable * bs)
 
     def _refresh_stats(self) -> None:

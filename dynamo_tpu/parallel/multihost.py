@@ -234,22 +234,32 @@ async def leader_gate(
 def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
     """Execute one leader plan. MUST run on the engine's step-executor
     thread (cache donation discipline); consumes RNG exactly as the
-    leader's execution path did."""
+    leader's execution path did. Ring ops ("rp"/"rsp"/"w") thread the
+    follower's own last_tok buffer — it evolves identically to the
+    leader's because every input that feeds it is replayed in order."""
     B = arrays["temp"].shape[0]
     top_p = arrays.get("top_p", np.ones((B,), np.float32))
     seeds = arrays.get("seeds", np.full((B,), -1, np.int32))
-    if kind == "m":
-        rngs = jax.random.split(
-            engine._next_rng(), engine.config.decode_steps
-        )
-        engine.cache, _ = engine._multistep_fn(
-            engine.params, engine.cache, arrays["tokens"],
+    if kind == "w":
+        rngs = jax.random.split(engine._next_rng(), engine._window_K)
+        engine.cache, engine._last_tok, _ = engine._decode_window_fn(
+            engine.params, engine.cache, engine._last_tok,
+            arrays["tok_host"], arrays["tok_src"], arrays["slots"],
             arrays["positions"], arrays["tables"], arrays["valid_until"],
             rngs, arrays["temp"], arrays["top_k"], top_p, seeds,
         )
-    else:
-        fn = engine._sp_prefill_fn if kind == "sp" else engine._step_fn
-        engine.cache, _ = fn(
+    elif kind in ("rp", "rsp"):
+        fn = (engine._sp_prefill_fn if kind == "rsp"
+              else engine._ring_prefill_fn)
+        engine.cache, engine._last_tok, _ = fn(
+            engine.params, engine.cache, engine._last_tok,
+            arrays["tokens"], arrays["positions"], arrays["tables"],
+            arrays["last_idx"], arrays["slot"], arrays["write"],
+            engine._next_rng(), arrays["temp"], arrays["top_k"],
+            top_p, seeds,
+        )
+    else:  # "p"/"d": the legacy synchronous unified step
+        engine.cache, _ = engine._step_fn(
             engine.params, engine.cache, arrays["tokens"],
             arrays["positions"], arrays["tables"], arrays["last_idx"],
             engine._next_rng(), arrays["temp"], arrays["top_k"],

@@ -263,7 +263,9 @@ async def test_queue_prefill_failure_reports_back(queue_disagg_pair):
     assert len(got) == 4
     assert decode_handler.num_local_prefills == 1
     assert qw.num_failed == 1
-    assert elapsed < 10.0, "failure was not reported back promptly"
+    # well under the fixture's 30 s queue_wait_s deadline, but tolerant of
+    # first-compile stalls when the whole suite shares the machine
+    assert elapsed < 20.0, "failure was not reported back promptly"
     assert not decode_handler.pending
 
 
