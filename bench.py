@@ -27,8 +27,10 @@ compilation cache amortises remote compiles across attempts.
 Env overrides: BENCH_ISL, BENCH_OSL, BENCH_CONCURRENCY, BENCH_REQUESTS,
 BENCH_MODEL (tiny|1b), BENCH_PROBE_TIMEOUT (default 600), BENCH_TIMEOUT
 (default 2400), BENCH_PROBE_RETRIES (default 2), BENCH_CACHE_DIR,
-BENCH_DECODE_STEPS (device-ring window length; default 16 on TPU),
-BENCH_PIPELINE_DEPTH (run-ahead windows in flight; default 4 on TPU).
+BENCH_DECODE_STEPS (autopilot window length; default 1 on TPU — in-program
+step chains defeat XLA cache aliasing), BENCH_PIPELINE_DEPTH (run-ahead
+windows in flight; default 16 on TPU), BENCH_BLOCK_LOOKAHEAD (blocks
+reserved ahead per seq; default 8 on TPU).
 """
 
 from __future__ import annotations
@@ -201,14 +203,20 @@ async def run_bench() -> dict:
     # perf.yaml:41-50) — on any model preset that fits the chip.
     model_name = os.environ.get("BENCH_MODEL", "1b" if on_tpu else "tiny")
     baseline_profile = os.environ.get("BENCH_PROFILE") == "baseline"
-    # Pipelined serving knobs. On TPU the host↔device sync is ~64 ms
-    # (remote PJRT), so decode runs 16-token device-ring windows with a
-    # 4-deep run-ahead pipeline — the sync never sits on the dispatch
-    # path (engine/model.py raw_decode_window_fn).
+    # Pipelined serving knobs, from measurement on this remote-PJRT TPU:
+    # a host sync is ~64 ms and each fresh host->device upload ~15 ms of
+    # serial channel time, while a chained 1B decode step is ~3 ms and an
+    # enqueue 0.3 ms — so decode runs K=1 autopilot windows (device-
+    # resident control state, zero uploads steady-state) under a deep
+    # run-ahead pipeline with grouped fetches. K>1 in-program windows are
+    # NOT faster here: XLA cannot keep the paged cache in place through
+    # an in-program step chain (~30x slowdown measured), so K stays 1.
     decode_steps = int(os.environ.get(
-        "BENCH_DECODE_STEPS", 16 if on_tpu else 4))
+        "BENCH_DECODE_STEPS", 1 if on_tpu else 4))
     pipe_depth = int(os.environ.get(
-        "BENCH_PIPELINE_DEPTH", 4 if on_tpu else 2))
+        "BENCH_PIPELINE_DEPTH", 16 if on_tpu else 2))
+    lookahead = int(os.environ.get(
+        "BENCH_BLOCK_LOOKAHEAD", 8 if on_tpu else 0))
     if model_name == "tiny":
         model_cfg = ModelConfig.tiny()
         defaults = (64, 16, 8, 24)
@@ -217,6 +225,7 @@ async def run_bench() -> dict:
             max_num_batched_tokens=256,
             prefill_buckets=(256,), decode_buckets=(16,), max_num_seqs=16,
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
+            block_lookahead=lookahead,
         )
     elif baseline_profile:
         factory = {"1b": ModelConfig.llama3_1b,
@@ -236,8 +245,10 @@ async def run_bench() -> dict:
         eng_cfg = EngineConfig(
             num_blocks=8192, max_model_len=1024,
             max_num_batched_tokens=1024,
-            prefill_buckets=(1024,), decode_buckets=(64,), max_num_seqs=64,
+            prefill_buckets=(512, 1024), decode_buckets=(64,),
+            max_num_seqs=64,
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
+            block_lookahead=lookahead,
         )
     isl = int(os.environ.get("BENCH_ISL", defaults[0]))
     osl = int(os.environ.get("BENCH_OSL", defaults[1]))
@@ -268,6 +279,7 @@ async def run_bench() -> dict:
             mesh_shape=tuple(int(x) for x in os.environ.get(
                 "BENCH_MESH", "1,1").split(",")),
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
+            block_lookahead=lookahead,
         )
 
     engine = InferenceEngine(model_cfg, eng_cfg)

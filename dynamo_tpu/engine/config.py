@@ -127,6 +127,10 @@ class EngineConfig:
     # ~64 ms vs a ~3 ms decode step, so the sync must never sit on the
     # dispatch path. 1 = classic synchronous loop (pp engines force 1).
     pipeline_depth: int = 2
+    # decode block lookahead: best-effort extra blocks reserved past each
+    # window so autopilot table/valid_until deltas (2 host uploads each)
+    # amortise over lookahead*block_size tokens instead of per-block
+    block_lookahead: int = 0
     # pipeline parallelism: >1 runs the unified step GPipe-style over a
     # ``pp`` mesh of that many stages (layers stage-sharded, decode
     # batches microbatched; parallel/pp_serving.py). Mutually exclusive

@@ -58,6 +58,92 @@ class Request:
     mm_hash_token_ids: Optional[List[int]] = None
 
 
+class _BatchingFetcher:
+    """One thread draining a queue of (batch, handles, future): ONE
+    ``jax.device_get`` per group of accumulated windows. On remote-PJRT
+    every get is a ~64 ms+ channel sync, so per-window fetching caps the
+    pipeline at ~15 windows/s; grouped fetching pays one sync for the
+    whole backlog and the dispatch side never waits."""
+
+    def __init__(self, unpack):
+        import queue as _queue
+
+        self._q: Any = _queue.Queue()
+        self._unpack = unpack
+        self._thread = None
+
+    def ensure_started(self) -> None:
+        if self._thread is None:
+            import threading
+
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tpu-fetch"
+            )
+            self._thread.start()
+
+    def submit(self, loop, batch, handles):
+        fut = loop.create_future()
+        self._q.put((loop, batch, handles, fut))
+        return fut
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._q.put(None)
+
+    def _run(self) -> None:
+        import queue as _queue
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            group = [item]
+            stop = False
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                group.append(nxt)
+            flat: List[Any] = []
+            spans = []
+            for (_, batch, handles, _f) in group:
+                ph, dh = handles
+                n0 = len(flat)
+                flat.extend(ph)
+                if dh is not None:
+                    flat.append(dh[0])
+                spans.append((n0, len(flat)))
+            try:
+                got = jax.device_get(flat) if flat else []
+                err = None
+            except Exception as e:  # donated-buffer poison, backend death
+                got, err = [], e
+            for (loop, batch, handles, fut), (a, b) in zip(group, spans):
+                if err is not None:
+                    loop.call_soon_threadsafe(_fut_set, fut, None, err)
+                    continue
+                try:
+                    res = self._unpack(batch, handles, got[a:b])
+                    loop.call_soon_threadsafe(_fut_set, fut, res, None)
+                except Exception as e:
+                    loop.call_soon_threadsafe(_fut_set, fut, None, e)
+            if stop:
+                return
+
+
+def _fut_set(fut, res, exc) -> None:
+    if fut.cancelled():
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(res)
+
+
 @dataclass
 class StepOutput:
     """One streamed generation step for a request."""
@@ -259,9 +345,14 @@ class EngineCore(AsyncEngine):
         finally:
             self._drop(seq)
 
+    def _ap_mark_dead(self, slot: int) -> None:
+        """Autopilot hook (overridden by the JAX engine): a seat whose seq
+        finished must be killed on device before its blocks recycle."""
+
     def abort(self, seq_id: str, reason: str = "cancelled") -> None:
         seq = self._seqs.get(seq_id)
         if seq is not None and seq.status != SeqStatus.FINISHED:
+            self._ap_mark_dead(seq.slot)
             self.scheduler.abort(seq, reason)
             self._emit_finish(seq, reason)
 
@@ -595,6 +686,8 @@ class EngineCore(AsyncEngine):
                 self._emit_token(seq)
             if applied < accepted:
                 self.scheduler.on_tokens_discarded(seq, accepted - applied)
+            if seq.status == SeqStatus.FINISHED and i < len(rows):
+                self._ap_mark_dead(rows[i].slot)
 
     def _emit_token(self, seq: SchedSeq) -> None:
         self.num_generated_tokens += 1
@@ -708,20 +801,31 @@ class InferenceEngine(EngineCore):
             self._step_fn = model_lib.make_step_fn(
                 model_config, engine_config, self.mesh
             )
-            # pipelined serving path: ring-posting prefill + unrolled
-            # decode windows fed from the device token ring
-            self._ring_prefill_fn = model_lib.make_ring_prefill_fn(
-                model_config, engine_config, self.mesh
-            )
+            # pipelined serving path: packed ring prefill + autopilot
+            # decode windows running on device-resident control state
             self._window_K = max(1, engine_config.decode_steps)
-            self._decode_window_fn = model_lib.make_decode_window_fn(
-                model_config, engine_config, self._window_K, self.mesh
+            self._ap_Wcap = engine_config.max_blocks_per_seq
+            self._ap_window_fn, self._ap_delta_fn = (
+                model_lib.make_autopilot_fns(
+                    model_config, engine_config, self._window_K,
+                    self._ap_Wcap, self.mesh,
+                )
             )
             from jax.sharding import NamedSharding, PartitionSpec
-            self._last_tok = jax.device_put(
-                np.zeros((engine_config.max_num_seqs + 1,), np.int32),
-                NamedSharding(self.mesh, PartitionSpec()),
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            self._ctl = jax.device_put(
+                model_lib.init_ctl(
+                    engine_config, engine_config.max_num_seqs,
+                    self._ap_Wcap, seed=seed + 2,
+                ),
+                repl,
             )
+            # host mirror of per-slot device state + seat map
+            self._packed_prefill_fns: Dict[Tuple[int, int], Any] = {}
+            self._ap: Dict[int, Dict[str, Any]] = {}
+            self._ap_cols: List[int] = []       # device slot_rows content
+            self._ap_rows_dev = None            # its device array
+            self._ap_dead: set = set()          # slots to kill next dispatch
             self.pipeline_depth = max(1, engine_config.pipeline_depth)
             if (engine_config.sp_prefill_threshold > 0
                     and self.mesh.devices.size > 1):
@@ -736,12 +840,11 @@ class InferenceEngine(EngineCore):
             max_workers=1, thread_name_prefix="tpu-step"
         )
         # fetches (device_get of sampled-token handles) run OFF the
-        # dispatch thread: a fetch is a host sync (~64 ms+ on remote-PJRT)
-        # and must never delay the next window's enqueue. Two workers so
-        # one slow fetch doesn't convoy the next landing.
-        self._fetch_exec = concurrent.futures.ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="tpu-fetch"
-        )
+        # dispatch thread on the batching fetcher: a fetch is a host sync
+        # (~64 ms+ on remote-PJRT) and must never delay the next window's
+        # enqueue; grouped gets keep the landing rate above the K=1
+        # window rate.
+        self._fetcher = _BatchingFetcher(self._unpack_results)
         # multi-host: the leader's broadcaster observes every executed step
         # so followers can replay the identical jitted call sequence
         # (parallel/multihost.py); called on the executor thread
@@ -758,7 +861,12 @@ class InferenceEngine(EngineCore):
 
     def _shutdown_executor(self) -> None:
         self._executor.shutdown(wait=False)
-        self._fetch_exec.shutdown(wait=False)
+        self._fetcher.stop()
+
+    def _ap_mark_dead(self, slot: int) -> None:
+        if self.pp == 1 and slot >= 0 and (
+                slot in self._ap or slot in self._ap_cols):
+            self._ap_dead.add(slot)
 
     # ------------------ KV block transfer (disagg) ---------------------
     # Both run on the single step executor thread, serialising them with
@@ -897,15 +1005,14 @@ class InferenceEngine(EngineCore):
 
     async def _dispatch_batch_async(self, batch):
         """Pipelined path: enqueue the batch's jitted calls on the dispatch
-        thread (no sync), then hand the sampled-token handles to a fetch
-        worker. Returns the asyncio future of the fetched results."""
+        thread (no sync), then hand the sampled-token handles to the
+        batching fetcher. Returns the asyncio future of the results."""
         loop = asyncio.get_running_loop()
         handles = await loop.run_in_executor(
             self._executor, self._dispatch_batch, batch
         )
-        return loop.run_in_executor(
-            self._fetch_exec, self._fetch_results, batch, handles
-        )
+        self._fetcher.ensure_started()
+        return self._fetcher.submit(loop, batch, handles)
 
     def _execute_batch(self, batch) -> Tuple[List[int], List[int]]:
         """Synchronous execution (pipeline_depth=1 / pp engines): dispatch
@@ -922,7 +1029,15 @@ class InferenceEngine(EngineCore):
 
     def _dispatch_batch(self, batch):
         """Executor thread: build arrays + enqueue every jitted call for
-        this window. NO host sync anywhere in here."""
+        this window. NO host sync anywhere in here. Seat kills (finished,
+        aborted, or preempted seqs whose blocks are recycling) flush FIRST
+        so the in-order device queue applies them before any work that
+        could touch reused blocks."""
+        for seq in batch.preempted:
+            if seq.preempted_slot >= 0:
+                self._ap_mark_dead(seq.preempted_slot)
+                seq.preempted_slot = -1
+        self._ap_flush_kills()
         prefill_handles = [
             self._dispatch_prefill(c) for c in batch.prefills
         ]
@@ -932,23 +1047,50 @@ class InferenceEngine(EngineCore):
         )
         return prefill_handles, decode_handle
 
+    def _ap_flush_kills(self) -> None:
+        """Kill dead autopilot seats (one packed delta call). The dead-set
+        swap is GIL-atomic against _ap_mark_dead calls from the event
+        loop; anything added after the swap rides the next dispatch."""
+        dead, self._ap_dead = self._ap_dead, set()
+        if not dead:
+            return
+        deltas = {}
+        for slot in dead:
+            deltas[slot] = {
+                "pos": 0, "vu": 0, "tk": 0, "seed": -1, "lt": -1,
+                "table": (), "temp": 0.0, "tp": 1.0,
+            }
+            self._ap.pop(slot, None)
+        self._ap_apply_deltas(deltas)
+
     def _fetch_results(self, batch, handles):
         """Fetch thread: device_get the window's sampled tokens (the only
         host↔device sync in the serving loop) and unpack per seat."""
         prefill_handles, decode_handle = handles
         to_get = list(prefill_handles)
         if decode_handle is not None:
-            to_get.append(decode_handle)
+            to_get.append(decode_handle[0])
         got = jax.device_get(to_get) if to_get else []
+        return self._unpack_results(batch, handles, got)
+
+    def _unpack_results(self, batch, handles, got):
+        """Map fetched arrays back to per-seat sample lists. Decode sample
+        columns follow the device seat map captured at dispatch, which may
+        order (and pad) differently than the batch's row list."""
+        prefill_handles, decode_handle = handles
         prefill_samples = [
             int(np.asarray(g)[0]) for g in got[:len(prefill_handles)]
         ]
         decode_samples: List[List[int]] = []
         if decode_handle is not None:
+            col_of = {}
+            for col, slot in enumerate(decode_handle[1]):
+                col_of.setdefault(slot, col)
             out = np.asarray(got[-1])  # [K, B]
-            for i, row in enumerate(batch.decode_rows):
+            for row in batch.decode_rows:
+                col = col_of[row.slot]
                 decode_samples.append(
-                    [int(out[k, i]) for k in range(row.accepted)]
+                    [int(out[k, col]) for k in range(row.accepted)]
                 )
         return prefill_samples, decode_samples
 
@@ -965,7 +1107,13 @@ class InferenceEngine(EngineCore):
             # sp full-prompt chunks (and any oversized chunk) bucket to the
             # next power of two — always divisible by the sp ring size
             T = _pow2_bucket(chunk.length)
-        W = _pow2_bucket(len(seq.block_table), cfg.max_blocks_per_seq)
+        # only the blocks this chunk can touch: keeps W a function of the
+        # chunk shape alone, so lookahead-grown tables don't mint new
+        # (T, W) programs mid-serving (remote compiles are ~50 s)
+        bs = cfg.block_size
+        nb = min((chunk.start + chunk.length + bs - 1) // bs,
+                 len(seq.block_table))
+        W = _pow2_bucket(nb, cfg.max_blocks_per_seq)
         tokens = np.zeros((1, T), np.int32)
         positions = np.full((1, T), -1, np.int32)
         all_toks = seq.all_tokens()
@@ -976,7 +1124,7 @@ class InferenceEngine(EngineCore):
             chunk.start, chunk.start + chunk.length
         )
         tables = np.zeros((1, W), np.int32)
-        tables[0, :len(seq.block_table)] = seq.block_table
+        tables[0, :nb] = seq.block_table[:nb]
         return {
             "tokens": tokens, "positions": positions, "tables": tables,
             "last_idx": np.array([chunk.length - 1], np.int32),
@@ -1030,77 +1178,149 @@ class InferenceEngine(EngineCore):
                 mm_embeds[0, row] = emb[k]
                 mm_mask[0, row] = True
             self.num_mm_prefills += 1
-            self.cache, self._last_tok, sampled = self._mm_ring_fn(
-                self.params, self.cache, self._last_tok, a["tokens"],
-                a["positions"], a["tables"], a["last_idx"], slot, write,
-                self._next_rng(), a["temp"], a["top_k"], a["top_p"],
-                a["seeds"], mm_embeds, mm_mask,
+            if self.step_sink is not None:
+                self.step_sink("mrp", {
+                    **a, "slot": slot, "write": write,
+                    "mm_embeds": mm_embeds,
+                    "mm_mask": mm_mask.astype(np.int32),
+                })
+            self.cache, new_lt, sampled = self._mm_ring_fn(
+                self.params, self.cache, self._ctl["last_tok"],
+                a["tokens"], a["positions"], a["tables"], a["last_idx"],
+                slot, write, self._next_rng(), a["temp"], a["top_k"],
+                a["top_p"], a["seeds"], mm_embeds, mm_mask,
             )
+            self._ctl = {**self._ctl, "last_tok": new_lt}
             return sampled
-        if self.step_sink is not None:
-            self.step_sink("rsp" if use_sp else "rp",
-                           {**a, "slot": slot, "write": write})
-        step = self._sp_prefill_fn if use_sp else self._ring_prefill_fn
         if use_sp:
+            if self.step_sink is not None:
+                self.step_sink("rsp", {**a, "slot": slot, "write": write})
             self.num_sp_prefills += 1
-        self.cache, self._last_tok, sampled = step(
-            self.params, self.cache, self._last_tok, a["tokens"],
-            a["positions"], a["tables"], a["last_idx"], slot, write,
-            self._next_rng(), a["temp"], a["top_k"], a["top_p"],
-            a["seeds"],
+            self.cache, new_lt, sampled = self._sp_prefill_fn(
+                self.params, self.cache, self._ctl["last_tok"],
+                a["tokens"], a["positions"], a["tables"], a["last_idx"],
+                slot, write, self._next_rng(), a["temp"], a["top_k"],
+                a["top_p"], a["seeds"],
+            )
+            self._ctl = {**self._ctl, "last_tok": new_lt}
+            return sampled
+        # plain path: pack every int input into ONE upload (2 total with
+        # the f32 pair) — prefill uploads dominate the serial channel
+        T = a["tokens"].shape[1]
+        W = a["tables"].shape[1]
+        fn = self._packed_prefill_fns.get((T, W))
+        if fn is None:
+            fn = model_lib.make_packed_prefill_fn(
+                self.model_config, cfg, T, W, self.mesh
+            )
+            self._packed_prefill_fns[(T, W)] = fn
+        pint = np.zeros((1, T + W + model_lib.PP_SCALARS), np.int32)
+        pint[0, :T] = a["tokens"][0]
+        pint[0, T:T + W] = a["tables"][0]
+        pint[0, T + W:] = (
+            chunk.length, chunk.start, int(slot[0]), int(write[0]),
+            seq.top_k, seq.seed,
         )
+        pf32 = np.array([seq.temperature, seq.top_p], np.float32)
+        if self.step_sink is not None:
+            self.step_sink("pp", {"pint": pint, "pf32": pf32,
+                                  "tw": np.array([T, W], np.int32)})
+        self.cache, new_lt, sampled = fn(
+            self.params, self.cache, self._ctl["last_tok"], pint, pf32,
+            self._next_rng(),
+        )
+        self._ctl = {**self._ctl, "last_tok": new_lt}
         return sampled
 
-    def _dispatch_decode(self, rows) -> jax.Array:
-        """Enqueue one ring decode window; returns the samples handle
-        [K, B]. Input tokens come from the device ring for rows whose
-        producer hasn't landed yet. No host sync."""
-        cfg = self.config
-        B = _bucket(len(rows), cfg.decode_buckets)
-        W = _pow2_bucket(
-            max(len(r.seq.block_table) for r in rows),
-            cfg.max_blocks_per_seq,
-        )
-        trash_slot = cfg.max_num_seqs
-        tok_host = np.zeros((B,), np.int32)
-        tok_src = np.zeros((B,), np.int32)
-        slots = np.full((B,), trash_slot, np.int32)
-        positions = np.zeros((B, 1), np.int32)
-        tables = np.zeros((B, W), np.int32)
-        temp = np.zeros((B,), np.float32)
-        top_k = np.zeros((B,), np.int32)
-        top_p = np.ones((B,), np.float32)
-        seeds = np.full((B,), -1, np.int32)
-        valid_until = np.zeros((B,), np.int32)
-        for i, r in enumerate(rows):
-            s = r.seq
-            tok_host[i] = r.tok_host
-            tok_src[i] = r.tok_src
-            slots[i] = r.slot if r.slot >= 0 else trash_slot
-            positions[i, 0] = r.base
-            tables[i, :len(s.block_table)] = s.block_table
-            temp[i] = s.temperature
-            top_k[i] = s.top_k
-            top_p[i] = s.top_p
-            seeds[i] = s.seed
-            # scatter guard: block capacity and model length; tokens past
-            # the cap go to the trash block and are discarded on landing
-            valid_until[i] = min(len(s.block_table) * cfg.block_size,
-                                 cfg.max_model_len)
+    def _ap_apply_deltas(self, deltas: Dict[int, Dict[str, Any]]) -> None:
+        """Pack + enqueue one control-state delta call (2 uploads total —
+        on the remote-PJRT tunnel each upload is ~15 ms of serial channel
+        time, so per-field arrays are unaffordable)."""
+        Wcap = self._ap_Wcap
+        n = _pow2_bucket(len(deltas))
+        trash = self.config.max_num_seqs
+        di = np.zeros((n, model_lib.CTL_I32_FIELDS + Wcap), np.int32)
+        di[:, 0] = trash               # pad rows scatter to the trash slot
+        di[:, 5] = -1                  # pad rows keep last_tok
+        df = np.zeros((n, 2), np.float32)
+        for i, (slot, d) in enumerate(sorted(deltas.items())):
+            di[i, 0] = slot
+            di[i, 1] = d["pos"]
+            di[i, 2] = d["vu"]
+            di[i, 3] = d["tk"]
+            di[i, 4] = d["seed"]
+            di[i, 5] = d["lt"]
+            table = d["table"]
+            di[i, 6:6 + len(table)] = table
+            df[i, 0] = d["temp"]
+            df[i, 1] = d["tp"]
         if self.step_sink is not None:
-            self.step_sink("w", {
-                "tok_host": tok_host, "tok_src": tok_src, "slots": slots,
-                "positions": positions, "tables": tables,
-                "valid_until": valid_until, "temp": temp, "top_k": top_k,
-                "top_p": top_p, "seeds": seeds,
-            })
-        rngs = jax.random.split(self._next_rng(), self._window_K)
-        self.cache, self._last_tok, samples = self._decode_window_fn(
-            self.params, self.cache, self._last_tok, tok_host, tok_src,
-            slots, positions, tables, valid_until, rngs, temp, top_k,
-            top_p, seeds,
+            self.step_sink("ctl", {"di": di, "df": df})
+        self._ctl = self._ap_delta_fn(self._ctl, di, df)
+
+    def _dispatch_decode(self, rows):
+        """Enqueue one autopilot decode window. Steady state (same seats,
+        no growth) dispatches with ZERO fresh host arrays — all control
+        state is device-resident; the host sends packed deltas only on
+        joins, block growth, resumes, and seat-map changes. Returns
+        (samples_handle [K, B], col_map) where col_map[device column] is
+        the slot computed there."""
+        cfg = self.config
+        bs = cfg.block_size
+        K = self._window_K
+        deltas: Dict[int, Dict[str, Any]] = {}
+        for r in rows:
+            s = r.seq
+            vu = min(len(s.block_table) * bs, cfg.max_model_len)
+            tlen = len(s.block_table)
+            params_key = (s.temperature, s.top_k, s.top_p, s.seed)
+            st = self._ap.get(r.slot)
+            if (st is None or st["seq_id"] != s.seq_id
+                    or st["pos"] != r.base or st["params"] != params_key):
+                # join / resume / drift: reset the whole slot. lt = -1
+                # keeps the ring token the producer wrote on device; a
+                # host-known token (resume, inject) is pushed instead.
+                deltas[r.slot] = {
+                    "pos": r.base, "vu": vu, "tk": s.top_k,
+                    "seed": s.seed,
+                    "lt": -1 if r.tok_src else r.tok_host,
+                    "table": s.block_table, "temp": s.temperature,
+                    "tp": s.top_p,
+                }
+            elif st["vu"] != vu or st["tlen"] != tlen:
+                deltas[r.slot] = {
+                    "pos": r.base, "vu": vu, "tk": s.top_k,
+                    "seed": s.seed, "lt": -1,
+                    "table": s.block_table, "temp": s.temperature,
+                    "tp": s.top_p,
+                }
+            # mirror the device's own advance: acc = clip(vu - pos, 0, K)
+            self._ap[r.slot] = {
+                "seq_id": s.seq_id, "params": params_key,
+                "pos": r.base + min(max(vu - r.base, 0), K),
+                "vu": vu, "tlen": tlen,
+            }
+        if deltas:
+            self._ap_apply_deltas(deltas)
+        # seat map: reuse the device map when all scheduled slots already
+        # hold seats (dead seats idle at vu=0); rebuild + upload otherwise
+        needed = [r.slot for r in rows]
+        B = _bucket(len(needed), cfg.decode_buckets)
+        if (self._ap_rows_dev is None or len(self._ap_cols) != B
+                or not set(needed) <= set(self._ap_cols)):
+            trash = cfg.max_num_seqs
+            cols = list(needed) + [trash] * (B - len(needed))
+            arr = np.asarray(cols, np.int32)
+            if self.step_sink is not None:
+                self.step_sink("cols", {"rows": arr})
+            self._ap_cols = cols
+            self._ap_rows_dev = jax.device_put(arr)
+        if self.step_sink is not None:
+            self.step_sink("w", {})
+        self.cache, self._ctl, samples = self._ap_window_fn(
+            self.params, self.cache, self._ctl, self._ap_rows_dev,
         )
-        return samples
+        return samples, list(self._ap_cols)
 
     # ---- legacy synchronous path (pipeline-parallel engines only) ----
 

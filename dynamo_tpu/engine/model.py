@@ -743,6 +743,142 @@ def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
     )
 
 
+# ------------------- decode autopilot (device-resident control) -----------
+#
+# The token ring removed the host from the token FEED; the autopilot
+# removes it from the control feed too. On the remote-PJRT tunnel each
+# host→device array upload costs ~15 ms of serial channel time — a decode
+# window that uploads 11 small arrays spends 160 ms on the channel for
+# 3 ms of compute (measured, 1B model). So ALL per-sequence decode state
+# lives on device, indexed by slot:
+#
+#   ctl = {pos, valid_until, temp, top_k, top_p, seed, last_tok [S+1],
+#          tables [S+1, Wcap], rng key, ctr}
+#
+# A steady-state decode window is dispatched with NO fresh host arrays —
+# the executable reads its seats from a device-resident ``slot_rows``
+# map. The host pushes packed DELTAS (one int32 [n, 6+Wcap] + one f32
+# [n, 2] upload) only when membership joins/leaves, blocks grow, or a
+# resumed sequence injects a host-known token, and re-uploads
+# ``slot_rows`` only on membership changes. Slot S is the trash slot:
+# delta pad rows target it, and dead seats (valid_until 0) advance
+# nothing and scatter to the trash block.
+#
+# This is the TPU-first redesign of the reference's per-step engine loop
+# (vLLM reads sampled ids back every step — affordable at ~10 µs GPU
+# sync, fatal at 64 ms): the device runs the decode loop; the host is a
+# delta stream plus a lagging observer.
+
+CTL_I32_FIELDS = 6  # slot, pos, valid_until, top_k, seed, last_tok
+
+
+def init_ctl(eng: EngineConfig, S: int, Wcap: int, seed: int = 0):
+    """Fresh device control state (host-side construction; device_put by
+    the caller with a replicated sharding)."""
+    return {
+        "pos": np.zeros((S + 1,), np.int32),
+        "vu": np.zeros((S + 1,), np.int32),
+        "temp": np.zeros((S + 1,), np.float32),
+        "tk": np.zeros((S + 1,), np.int32),
+        "tp": np.ones((S + 1,), np.float32),
+        "seed": np.full((S + 1,), -1, np.int32),
+        "last_tok": np.zeros((S + 1,), np.int32),
+        "tables": np.zeros((S + 1, Wcap), np.int32),
+        "key": jax.random.PRNGKey(seed),
+        "ctr": np.zeros((), np.int32),
+    }
+
+
+def raw_ctl_delta_fn(Wcap: int):
+    """Apply a packed delta to the control state.
+
+    delta_i32 [n, 6 + Wcap]: slot, pos, valid_until, top_k, seed,
+    last_tok (-1 = keep the ring value — joins after an on-device prefill
+    must not clobber the sampled token), then the full table row.
+    delta_f32 [n, 2]: temperature, top_p. Pad rows use slot = S (trash).
+    """
+
+    def apply(ctl, delta_i32, delta_f32):
+        slots = delta_i32[:, 0]
+        ctl = dict(ctl)
+        ctl["pos"] = ctl["pos"].at[slots].set(delta_i32[:, 1])
+        ctl["vu"] = ctl["vu"].at[slots].set(delta_i32[:, 2])
+        ctl["tk"] = ctl["tk"].at[slots].set(delta_i32[:, 3])
+        ctl["seed"] = ctl["seed"].at[slots].set(delta_i32[:, 4])
+        lt = delta_i32[:, 5]
+        ctl["last_tok"] = ctl["last_tok"].at[slots].set(
+            jnp.where(lt >= 0, lt, ctl["last_tok"][slots])
+        )
+        ctl["tables"] = ctl["tables"].at[slots].set(delta_i32[:, 6:])
+        ctl["temp"] = ctl["temp"].at[slots].set(delta_f32[:, 0])
+        ctl["tp"] = ctl["tp"].at[slots].set(delta_f32[:, 1])
+        return ctl
+
+    return apply
+
+
+def raw_autopilot_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
+                            mesh: Optional[Mesh] = None):
+    """K unrolled decode steps reading EVERYTHING from device state.
+
+    Signature: window(params, cache, ctl, slot_rows[B]) ->
+    (cache, ctl, samples[K, B]).
+
+    Dead seats (valid_until <= pos) compute garbage into the trash block
+    and advance nothing; their sample columns are discarded by the host.
+    Step rngs derive from the carried key + counter, so a window dispatch
+    carries zero fresh host arrays.
+    """
+
+    def window(params, cache, ctl, slot_rows):
+        rows = slot_rows
+        tok = ctl["last_tok"][rows][:, None]
+        pos0 = ctl["pos"][rows]
+        vu = ctl["vu"][rows]
+        temp = ctl["temp"][rows]
+        tk = ctl["tk"][rows]
+        tp = ctl["tp"][rows]
+        sd = ctl["seed"][rows]
+        tables = ctl["tables"][rows]
+        pos = pos0[:, None]
+        outs = []
+        for k in range(K):
+            rng_k = jax.random.fold_in(ctl["key"], ctl["ctr"] * K + k)
+            pos_eff = jnp.where(pos < vu[:, None], pos, -1)
+            cache, h = forward(
+                cfg, eng, params, cache, tok, pos_eff, tables, mesh=mesh,
+            )
+            logits = logits_fn(cfg, params, h[:, 0])
+            s = sample(logits, rng_k, temp, tk, tp, sd, pos[:, 0])
+            outs.append(s)
+            tok, pos = s[:, None], pos + 1
+        samples = jnp.stack(outs)                          # [K, B]
+        acc = jnp.clip(vu - pos0, 0, K)                    # [B]
+        final = jnp.take_along_axis(
+            samples, jnp.maximum(acc - 1, 0)[None, :], axis=0
+        )[0]
+        S = ctl["last_tok"].shape[0] - 1
+        write_rows = jnp.where(acc > 0, rows, S)
+        ctl = dict(ctl)
+        ctl["last_tok"] = ctl["last_tok"].at[write_rows].set(final)
+        # duplicate trash rows accumulate zero (acc there is 0)
+        ctl["pos"] = ctl["pos"].at[rows].add(acc)
+        ctl["ctr"] = ctl["ctr"] + 1
+        return cache, ctl, samples
+
+    return window
+
+
+def make_autopilot_fns(cfg: ModelConfig, eng: EngineConfig, K: int,
+                       Wcap: int, mesh: Optional[Mesh] = None):
+    """(window_fn, delta_fn) jitted with cache/ctl donated."""
+    window = jax.jit(
+        raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+    )
+    delta = jax.jit(raw_ctl_delta_fn(Wcap), donate_argnums=(0,))
+    return window, delta
+
+
 def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                         mesh: Optional[Mesh] = None,
                         ring_mesh: Optional[Mesh] = None):
@@ -774,6 +910,56 @@ def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         return cache, last_tok, sampled
 
     return prefill
+
+
+PP_SCALARS = 6  # n, start, slot, write, top_k, seed
+
+
+def raw_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                          T: int, W: int,
+                          mesh: Optional[Mesh] = None):
+    """Ring prefill with ALL int inputs packed into ONE upload.
+
+    ``pint [1, T + W + PP_SCALARS]`` = tokens(T), tables(W), then n,
+    start, slot, write, top_k, seed; ``pf32 [2]`` = temperature, top_p.
+    Positions are derived on device (start + iota, -1 pads), so one
+    prefill costs 2 host uploads instead of 8 — on remote-PJRT each
+    upload is ~15 ms of serial channel time, and at ISL 512 the prefill
+    upload stream was the single largest channel consumer.
+    """
+    base = raw_step_fn(cfg, eng, mesh)
+
+    def prefill(params, cache, last_tok, pint, pf32, rng):
+        tokens = pint[:, :T]
+        tables = pint[:, T:T + W]
+        n = pint[0, T + W + 0]
+        start = pint[0, T + W + 1]
+        slot = pint[0, T + W + 2]
+        write = pint[0, T + W + 3]
+        top_k = pint[0:1, T + W + 4]
+        seed = pint[0:1, T + W + 5]
+        idx = jnp.arange(T, dtype=jnp.int32)
+        positions = jnp.where(idx < n, start + idx, -1)[None, :]
+        last_idx = jnp.maximum(n - 1, 0)[None]
+        temp = pf32[0:1]
+        tp = pf32[1:2]
+        cache, sampled = base(
+            params, cache, tokens, positions, tables, last_idx, rng,
+            temp, top_k, tp, seed,
+        )
+        S = last_tok.shape[0] - 1
+        slot_eff = jnp.where(write > 0, slot, S)[None]
+        last_tok = last_tok.at[slot_eff].set(sampled)
+        return cache, last_tok, sampled
+
+    return prefill
+
+
+def make_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
+                           T: int, W: int, mesh: Optional[Mesh] = None):
+    return jax.jit(
+        raw_packed_prefill_fn(cfg, eng, T, W, mesh), donate_argnums=(1, 2)
+    )
 
 
 def make_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,

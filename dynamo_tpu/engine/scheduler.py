@@ -220,6 +220,8 @@ class SchedSeq:
     # ---- pipelined (run-ahead) serving state ----
     # device token-ring slot (-1 = unassigned); see model.raw_decode_window_fn
     slot: int = -1
+    # slot held when this seq was last preempted (engine kills the seat)
+    preempted_slot: int = -1
     # dispatched-but-unlanded work (speculative scheduling reads through it)
     pending_prompt: int = 0   # prefill chunk tokens in flight
     pending_first: int = 0    # 1 while the prompt-completing sample is in flight
@@ -279,13 +281,17 @@ class DecodeRow:
 @dataclass
 class ScheduledBatch:
     prefills: List[PrefillChunk] = field(default_factory=list)
-    decodes: List[SchedSeq] = field(default_factory=list)
     decode_rows: List[DecodeRow] = field(default_factory=list)
     preempted: List[SchedSeq] = field(default_factory=list)
 
     @property
+    def decodes(self) -> List[SchedSeq]:
+        # derived view — decode_rows is the single source of truth
+        return [r.seq for r in self.decode_rows]
+
+    @property
     def is_empty(self) -> bool:
-        return not self.prefills and not self.decodes
+        return not self.prefills and not self.decode_rows
 
 
 @dataclass
@@ -351,6 +357,39 @@ class Scheduler:
         # can be planned before the previous one lands, with the input
         # token fed from the device ring (run-ahead pipelining).
         window = max(1, self.config.decode_steps)
+        if self.config.block_lookahead:
+            # SYNCHRONISED lookahead: when any running seq's runway drops
+            # below half the lookahead, top up EVERY running seq to the
+            # full lookahead in the same round — growth then lands in ONE
+            # device-state delta (2 uploads) per cycle instead of one
+            # per seq per round (the uploads are the serving bottleneck
+            # on remote-PJRT, ~15 ms of serial channel time each)
+            la = self.config.block_lookahead * bs
+            trigger = False
+            for seq in self.running:
+                if seq.status is not SeqStatus.RUNNING:
+                    continue
+                base = (seq.num_computed + seq.pending_prompt
+                        + seq.pending_decode)
+                if base >= self.config.max_model_len:
+                    continue
+                if len(seq.block_table) * bs - base < max(window, la // 2):
+                    trigger = True
+                    break
+            if trigger:
+                for seq in self.running:
+                    if seq.status is not SeqStatus.RUNNING:
+                        continue
+                    base = (seq.num_computed + seq.pending_prompt
+                            + seq.pending_decode)
+                    tgt = min(base + window - 1 + la,
+                              self.config.max_model_len - 1)
+                    while (len(seq.block_table) * bs <= tgt
+                           and self._can_allocate(1)):
+                        bid = self.pool.allocate()
+                        if bid is None:
+                            break
+                        seq.block_table.append(bid)
         for seq in list(self.running):
             if budget <= 0:
                 break
@@ -377,7 +416,6 @@ class Scheduler:
             ))
             seq.pending_decode += accepted
             budget -= 1
-            batch.decodes.append(seq)
 
         # 2. chunked prefill from the waiting queue, FIFO.  A prefill that
         # completed admission already moved into self.running, so only count
@@ -594,6 +632,9 @@ class Scheduler:
     def _preempt(self, seq: SchedSeq, batch: ScheduledBatch) -> None:
         assert seq.pending_total == 0, "preempting a seq with inflight work"
         log.info("preempting seq %s (recompute)", seq.seq_id)
+        # the engine must kill the device autopilot seat before these
+        # blocks recycle — batch.preempted carries the slot it held
+        seq.preempted_slot = seq.slot
         self._release_blocks(seq)
         self._free_slot(seq)
         seq.num_computed = 0

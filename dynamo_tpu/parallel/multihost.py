@@ -237,27 +237,63 @@ def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
     leader's execution path did. Ring ops ("rp"/"rsp"/"w") thread the
     follower's own last_tok buffer — it evolves identically to the
     leader's because every input that feeds it is replayed in order."""
+    if kind == "w":
+        # autopilot window: zero arrays — the follower's device control
+        # state and seat map evolved identically through "ctl"/"cols"
+        engine.cache, engine._ctl, _ = engine._ap_window_fn(
+            engine.params, engine.cache, engine._ctl,
+            engine._ap_rows_dev,
+        )
+        return
+    if kind == "ctl":
+        engine._ctl = engine._ap_delta_fn(
+            engine._ctl, arrays["di"], arrays["df"]
+        )
+        return
+    if kind == "cols":
+        engine._ap_cols = [int(x) for x in arrays["rows"]]
+        engine._ap_rows_dev = jax.device_put(arrays["rows"])
+        return
+    if kind == "pp":
+        from ..engine import model as model_lib
+
+        T, W = (int(x) for x in arrays["tw"])
+        fn = engine._packed_prefill_fns.get((T, W))
+        if fn is None:
+            fn = model_lib.make_packed_prefill_fn(
+                engine.model_config, engine.config, T, W, engine.mesh
+            )
+            engine._packed_prefill_fns[(T, W)] = fn
+        engine.cache, new_lt, _ = fn(
+            engine.params, engine.cache, engine._ctl["last_tok"],
+            arrays["pint"], arrays["pf32"], engine._next_rng(),
+        )
+        engine._ctl = {**engine._ctl, "last_tok": new_lt}
+        return
     B = arrays["temp"].shape[0]
     top_p = arrays.get("top_p", np.ones((B,), np.float32))
     seeds = arrays.get("seeds", np.full((B,), -1, np.int32))
-    if kind == "w":
-        rngs = jax.random.split(engine._next_rng(), engine._window_K)
-        engine.cache, engine._last_tok, _ = engine._decode_window_fn(
-            engine.params, engine.cache, engine._last_tok,
-            arrays["tok_host"], arrays["tok_src"], arrays["slots"],
-            arrays["positions"], arrays["tables"], arrays["valid_until"],
-            rngs, arrays["temp"], arrays["top_k"], top_p, seeds,
-        )
-    elif kind in ("rp", "rsp"):
+    if kind in ("rsp", "mrp"):
+        if kind == "mrp" and engine._mm_ring_fn is None:
+            from ..engine import model as model_lib
+
+            engine._mm_ring_fn = model_lib.make_mm_ring_prefill_fn(
+                engine.model_config, engine.config, engine.mesh
+            )
+        extra = ()
+        if kind == "mrp":
+            extra = (arrays["mm_embeds"],
+                     arrays["mm_mask"].astype(bool))
         fn = (engine._sp_prefill_fn if kind == "rsp"
-              else engine._ring_prefill_fn)
-        engine.cache, engine._last_tok, _ = fn(
-            engine.params, engine.cache, engine._last_tok,
+              else engine._mm_ring_fn)
+        engine.cache, new_lt, _ = fn(
+            engine.params, engine.cache, engine._ctl["last_tok"],
             arrays["tokens"], arrays["positions"], arrays["tables"],
             arrays["last_idx"], arrays["slot"], arrays["write"],
             engine._next_rng(), arrays["temp"], arrays["top_k"],
-            top_p, seeds,
+            top_p, seeds, *extra,
         )
+        engine._ctl = {**engine._ctl, "last_tok": new_lt}
     else:  # "p"/"d": the legacy synchronous unified step
         engine.cache, _ = engine._step_fn(
             engine.params, engine.cache, arrays["tokens"],
