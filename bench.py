@@ -244,7 +244,9 @@ async def run_bench() -> dict:
         # mid-measurement compile stalls
         eng_cfg = EngineConfig(
             num_blocks=8192, max_model_len=1024,
-            max_num_batched_tokens=1024,
+            # budget > bucket: decode seats coexist with a full 512-token
+            # prefill chunk instead of splitting prompts 448+64
+            max_num_batched_tokens=1024 + 64,
             prefill_buckets=(512, 1024), decode_buckets=(64,),
             max_num_seqs=64,
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
@@ -367,6 +369,14 @@ async def run_bench() -> dict:
         "n_params": n_params,
         "processed_tok_s": round(processed, 1),
         "mfu": round(mfu, 4),
+        # channel-traffic counters: each delta is 2 uploads, each prefill
+        # 2, cols 1, windows 0 — the serial-channel budget explains the
+        # gap between device compute (~3 ms/window) and wall time
+        "num_windows": getattr(engine, "num_windows", 0),
+        "num_deltas": getattr(engine, "num_deltas", 0),
+        "num_delta_rows": getattr(engine, "num_delta_rows", 0),
+        "num_cols_uploads": getattr(engine, "num_cols_uploads", 0),
+        "num_prefills": getattr(engine, "num_prefill_dispatches", 0),
     }
     if on_tpu:
         try:

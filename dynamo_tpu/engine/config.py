@@ -149,10 +149,9 @@ class EngineConfig:
             raise ValueError("pp_stages and a (dp, tp) mesh are exclusive")
         if self.max_num_seqs > max(self.decode_buckets):
             raise ValueError("max_num_seqs exceeds largest decode bucket")
-        if self.max_num_batched_tokens > max(self.prefill_buckets):
-            raise ValueError(
-                "max_num_batched_tokens exceeds largest prefill bucket"
-            )
+        # max_num_batched_tokens MAY exceed the largest prefill bucket:
+        # the scheduler caps each chunk at the bucket, so extra budget
+        # just lets decode seats coexist with a full-bucket prefill
 
     @property
     def max_blocks_per_seq(self) -> int:

@@ -533,6 +533,7 @@ class EngineCore(AsyncEngine):
             while inflight and inflight[0][1].done():
                 await land_next()
             batch = self.scheduler.schedule()
+            self._mark_preempted_seats(batch)
             if batch.is_empty:
                 if inflight:
                     await land_next()
@@ -575,19 +576,23 @@ class EngineCore(AsyncEngine):
 
     def _abort_batch(self, batch) -> None:
         """Fail every seq a dispatched-or-dispatching batch touches and
-        clear the speculative pendings it registered."""
+        clear the speculative pendings it registered. Seats are marked
+        dead BEFORE the abort releases blocks — otherwise the device
+        autopilot keeps scattering into recycled blocks."""
         for chunk in batch.prefills:
             seq = chunk.seq
             self.scheduler.on_tokens_discarded(
                 seq, 0, first=chunk.final, prompt=chunk.length
             )
             if seq.status != SeqStatus.FINISHED:
+                self._ap_mark_dead(seq.slot)
                 self.scheduler.abort(seq, "error")
                 self._emit_finish(seq, "error")
         for row in batch.decode_rows:
             seq = row.seq
             self.scheduler.on_tokens_discarded(seq, row.accepted)
             if seq.status != SeqStatus.FINISHED:
+                self._ap_mark_dead(row.slot)
                 self.scheduler.abort(seq, "error")
                 self._emit_finish(seq, "error")
 
@@ -603,9 +608,20 @@ class EngineCore(AsyncEngine):
             fut.set_exception(e)
         return fut
 
+    def _mark_preempted_seats(self, batch) -> None:
+        """A preempted seq's blocks were just released — its device seat
+        must die before they recycle, even if this batch is otherwise
+        empty (the kill rides the next dispatch, which in-order precedes
+        any reuse)."""
+        for seq in batch.preempted:
+            if seq.preempted_slot >= 0:
+                self._ap_mark_dead(seq.preempted_slot)
+                seq.preempted_slot = -1
+
     async def _run_loop_sync(self) -> None:
         while not self._stopped:
             batch = self.scheduler.schedule()
+            self._mark_preempted_seats(batch)
             if batch.is_empty:
                 # a waiting request that can never fit (pool smaller than its
                 # prompt) would hang forever — fail it rather than deadlock
@@ -671,23 +687,24 @@ class EngineCore(AsyncEngine):
             )
             if chunk.final:
                 self._emit_token(seq)
-        rows = batch.decode_rows
-        for i, seq in enumerate(batch.decodes):
+        for i, row in enumerate(batch.decode_rows):
+            seq = row.seq
             window = decode_samples[i]
             if isinstance(window, int):
                 window = [window]
-            accepted = rows[i].accepted if i < len(rows) else len(window)
             applied = 0
-            for tok in window[:accepted]:
+            for tok in window[:row.accepted]:
                 if seq.status == SeqStatus.FINISHED:
                     break  # aborted / stopped mid-window
                 self.scheduler.on_decode_executed(seq, tok)
                 applied += 1
                 self._emit_token(seq)
-            if applied < accepted:
-                self.scheduler.on_tokens_discarded(seq, accepted - applied)
-            if seq.status == SeqStatus.FINISHED and i < len(rows):
-                self._ap_mark_dead(rows[i].slot)
+            if applied < row.accepted:
+                self.scheduler.on_tokens_discarded(
+                    seq, row.accepted - applied
+                )
+            if seq.status == SeqStatus.FINISHED:
+                self._ap_mark_dead(row.slot)
 
     def _emit_token(self, seq: SchedSeq) -> None:
         self.num_generated_tokens += 1
@@ -822,6 +839,12 @@ class InferenceEngine(EngineCore):
             )
             # host mirror of per-slot device state + seat map
             self._packed_prefill_fns: Dict[Tuple[int, int], Any] = {}
+            # channel-traffic counters (surfaced by bench.py)
+            self.num_windows = 0
+            self.num_deltas = 0
+            self.num_delta_rows = 0
+            self.num_cols_uploads = 0
+            self.num_prefill_dispatches = 0
             self._ap: Dict[int, Dict[str, Any]] = {}
             self._ap_cols: List[int] = []       # device slot_rows content
             self._ap_rows_dev = None            # its device array
@@ -1032,11 +1055,8 @@ class InferenceEngine(EngineCore):
         this window. NO host sync anywhere in here. Seat kills (finished,
         aborted, or preempted seqs whose blocks are recycling) flush FIRST
         so the in-order device queue applies them before any work that
-        could touch reused blocks."""
-        for seq in batch.preempted:
-            if seq.preempted_slot >= 0:
-                self._ap_mark_dead(seq.preempted_slot)
-                seq.preempted_slot = -1
+        could touch reused blocks. Preempted slots are marked by the loop
+        at schedule() time — a batch can be empty yet carry preemptions."""
         self._ap_flush_kills()
         prefill_handles = [
             self._dispatch_prefill(c) for c in batch.prefills
@@ -1152,6 +1172,7 @@ class InferenceEngine(EngineCore):
         handle [1] (garbage unless ``chunk.final``). No host sync."""
         cfg = self.config
         seq = chunk.seq
+        self.num_prefill_dispatches += 1
         use_sp = (
             self._sp_prefill_fn is not None
             and chunk.start == 0 and chunk.final
@@ -1220,13 +1241,14 @@ class InferenceEngine(EngineCore):
         pint[0, T + W:] = (
             chunk.length, chunk.start, int(slot[0]), int(write[0]),
             seq.top_k, seq.seed,
+            int(round(seq.temperature * model_lib.PP_QUANT)),
+            int(round(seq.top_p * model_lib.PP_QUANT)),
         )
-        pf32 = np.array([seq.temperature, seq.top_p], np.float32)
         if self.step_sink is not None:
-            self.step_sink("pp", {"pint": pint, "pf32": pf32,
+            self.step_sink("pp", {"pint": pint,
                                   "tw": np.array([T, W], np.int32)})
         self.cache, new_lt, sampled = fn(
-            self.params, self.cache, self._ctl["last_tok"], pint, pf32,
+            self.params, self.cache, self._ctl["last_tok"], pint,
             self._next_rng(),
         )
         self._ctl = {**self._ctl, "last_tok": new_lt}
@@ -1256,6 +1278,8 @@ class InferenceEngine(EngineCore):
             df[i, 1] = d["tp"]
         if self.step_sink is not None:
             self.step_sink("ctl", {"di": di, "df": df})
+        self.num_deltas += 1
+        self.num_delta_rows += len(deltas)
         self._ctl = self._ap_delta_fn(self._ctl, di, df)
 
     def _dispatch_decode(self, rows):
@@ -1314,9 +1338,11 @@ class InferenceEngine(EngineCore):
             if self.step_sink is not None:
                 self.step_sink("cols", {"rows": arr})
             self._ap_cols = cols
+            self.num_cols_uploads += 1
             self._ap_rows_dev = jax.device_put(arr)
         if self.step_sink is not None:
             self.step_sink("w", {})
+        self.num_windows += 1
         self.cache, self._ctl, samples = self._ap_window_fn(
             self.params, self.cache, self._ctl, self._ap_rows_dev,
         )

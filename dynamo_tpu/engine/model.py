@@ -912,24 +912,26 @@ def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     return prefill
 
 
-PP_SCALARS = 6  # n, start, slot, write, top_k, seed
+PP_SCALARS = 8   # n, start, slot, write, top_k, seed, temp_q, top_p_q
+PP_QUANT = 1e4   # temperature / top_p fixed-point scale in the int pack
 
 
 def raw_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                           T: int, W: int,
                           mesh: Optional[Mesh] = None):
-    """Ring prefill with ALL int inputs packed into ONE upload.
+    """Ring prefill with ALL inputs packed into ONE upload.
 
     ``pint [1, T + W + PP_SCALARS]`` = tokens(T), tables(W), then n,
-    start, slot, write, top_k, seed; ``pf32 [2]`` = temperature, top_p.
-    Positions are derived on device (start + iota, -1 pads), so one
-    prefill costs 2 host uploads instead of 8 — on remote-PJRT each
-    upload is ~15 ms of serial channel time, and at ISL 512 the prefill
-    upload stream was the single largest channel consumer.
+    start, slot, write, top_k, seed, temp*1e4, top_p*1e4 (fixed-point —
+    1e-4 sampling-parameter resolution is far below any behavioral
+    threshold). Positions are derived on device (start + iota, -1 pads),
+    so one prefill costs ONE host upload instead of 8 — on remote-PJRT
+    each upload is ~15 ms of serial channel time, and at ISL 512 the
+    prefill upload stream was the single largest channel consumer.
     """
     base = raw_step_fn(cfg, eng, mesh)
 
-    def prefill(params, cache, last_tok, pint, pf32, rng):
+    def prefill(params, cache, last_tok, pint, rng):
         tokens = pint[:, :T]
         tables = pint[:, T:T + W]
         n = pint[0, T + W + 0]
@@ -938,11 +940,11 @@ def raw_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         write = pint[0, T + W + 3]
         top_k = pint[0:1, T + W + 4]
         seed = pint[0:1, T + W + 5]
+        temp = pint[0:1, T + W + 6].astype(jnp.float32) / PP_QUANT
+        tp = pint[0:1, T + W + 7].astype(jnp.float32) / PP_QUANT
         idx = jnp.arange(T, dtype=jnp.int32)
         positions = jnp.where(idx < n, start + idx, -1)[None, :]
         last_idx = jnp.maximum(n - 1, 0)[None]
-        temp = pf32[0:1]
-        tp = pf32[1:2]
         cache, sampled = base(
             params, cache, tokens, positions, tables, last_idx, rng,
             temp, top_k, tp, seed,

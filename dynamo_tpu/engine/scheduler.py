@@ -451,8 +451,22 @@ class Scheduler:
                 chunk = remaining
             else:
                 # chunk ≤ budget, so a partial chunk always exhausts the
-                # budget and the loop cannot schedule a token range twice
-                chunk = min(budget, remaining)
+                # budget and the loop cannot schedule a token range twice.
+                # Also never exceed the largest compiled prefill bucket —
+                # that lets max_num_batched_tokens run past the bucket so
+                # decode seats don't force prompt splits (a 512 prompt
+                # split 448+64 costs a full extra dispatch + uploads).
+                max_bucket = max(self.config.prefill_buckets)
+                chunk = min(budget, remaining, max_bucket)
+                if (chunk < remaining and chunk < max_bucket
+                        and batch.prefills):
+                    # fragment caused by earlier prefills eating the
+                    # budget: the tail would cost a whole extra dispatch
+                    # (padded to a full bucket) — defer this prompt to
+                    # the next round, which grants a fresh budget. The
+                    # FIRST prefill of a batch never defers, so budget-
+                    # limited chunked prefill still makes progress.
+                    break
             # blocks needed to hold [start, start + chunk)
             have = len(seq.block_table)
             need = (start + chunk + bs - 1) // bs - have

@@ -266,7 +266,7 @@ def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
             engine._packed_prefill_fns[(T, W)] = fn
         engine.cache, new_lt, _ = fn(
             engine.params, engine.cache, engine._ctl["last_tok"],
-            arrays["pint"], arrays["pf32"], engine._next_rng(),
+            arrays["pint"], engine._next_rng(),
         )
         engine._ctl = {**engine._ctl, "last_tok": new_lt}
         return
