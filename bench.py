@@ -274,7 +274,9 @@ async def run_bench() -> dict:
             num_blocks=int(os.environ.get("BENCH_NUM_BLOCKS",
                                           max(8192, blocks_needed))),
             max_model_len=seq_len,
-            max_num_batched_tokens=chunk,
+            # budget > chunk bucket: decode seats coexist with a full
+            # chunk instead of fragmenting every prompt
+            max_num_batched_tokens=chunk + _pow2(concurrency),
             prefill_buckets=(chunk,),
             decode_buckets=(_pow2(concurrency),),
             max_num_seqs=concurrency,

@@ -199,12 +199,26 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+_Q_BLOCK = 512  # query-block size for long prefill chunks: caps the f32
+                # score tensor at [B, H, _Q_BLOCK, S] — an unblocked
+                # 4096-token chunk against 8k context materialises 4.3 GB
+                # of scores and OOMs next to a serving-sized KV cache
+
+
 def _attention(
     q: jax.Array,        # [B, T, H, hd]
     k_all: jax.Array,    # [B, S, KV, hd]  gathered sequence KV
     v_all: jax.Array,    # [B, S, KV, hd]
     positions: jax.Array,  # [B, T] absolute positions (-1 = pad)
 ) -> jax.Array:
+    T = q.shape[1]
+    if T > _Q_BLOCK:
+        outs = [
+            _attention(q[:, t0:t0 + _Q_BLOCK], k_all, v_all,
+                       positions[:, t0:t0 + _Q_BLOCK])
+            for t0 in range(0, T, _Q_BLOCK)
+        ]
+        return jnp.concatenate(outs, axis=1)
     B, T, H, hd = q.shape
     S, KV = k_all.shape[1], k_all.shape[2]
     G = H // KV
