@@ -1,0 +1,58 @@
+"""Query-blocked prefill attention (_Q_BLOCK) is numerically identical to
+the unblocked path — the long-context OOM fix must not change results."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import model as M
+
+
+def test_blocked_matches_unblocked():
+    B, T, H, KV, hd = 2, M._Q_BLOCK + 192, 8, 4, 32  # crosses the block
+    S = T + 64
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
+
+    blocked = M._attention(q, k, v, positions)
+
+    # reference: force the single-block path by processing T <= _Q_BLOCK
+    # slices through the same kernel and comparing against the full-T
+    # result reassembled (softmax is independent per query row)
+    parts = [
+        M._attention(q[:, t0:t0 + 256], k, v, positions[:, t0:t0 + 256])
+        for t0 in range(0, T, 256)
+    ]
+    ref = jnp.concatenate(parts, axis=1)
+
+    np.testing.assert_allclose(
+        np.asarray(blocked), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_blocked_causality_with_pads():
+    """Pad rows (-1 positions) attend to nothing meaningful and the causal
+    mask is absolute-position based across block boundaries."""
+    B, T, H, KV, hd = 1, M._Q_BLOCK + 64, 4, 2, 16
+    S = T
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    n_valid = M._Q_BLOCK + 10
+    positions = np.full((B, T), -1, np.int32)
+    positions[0, :n_valid] = np.arange(n_valid)
+
+    out = M._attention(q, k, v, jnp.asarray(positions))
+    # future KV must not influence a query: perturb keys past the last
+    # valid position and check valid outputs are unchanged
+    k2 = k.at[:, n_valid:].add(100.0)
+    v2 = v.at[:, n_valid:].add(100.0)
+    out2 = M._attention(q, k2, v2, jnp.asarray(positions))
+    np.testing.assert_allclose(
+        np.asarray(out[:, :n_valid]), np.asarray(out2[:, :n_valid]),
+        rtol=1e-5, atol=1e-5,
+    )
