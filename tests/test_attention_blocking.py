@@ -19,12 +19,14 @@ def test_blocked_matches_unblocked():
 
     blocked = M._attention(q, k, v, positions)
 
-    # reference: force the single-block path by processing T <= _Q_BLOCK
-    # slices through the same kernel and comparing against the full-T
-    # result reassembled (softmax is independent per query row)
+    # reference: force the single-block path by processing slices strictly
+    # below _Q_BLOCK through the same kernel and reassembling (softmax is
+    # independent per query row). Deriving the slice from _Q_BLOCK keeps
+    # this a blocked-vs-unblocked comparison if the constant changes.
+    step = M._Q_BLOCK // 2
     parts = [
-        M._attention(q[:, t0:t0 + 256], k, v, positions[:, t0:t0 + 256])
-        for t0 in range(0, T, 256)
+        M._attention(q[:, t0:t0 + step], k, v, positions[:, t0:t0 + step])
+        for t0 in range(0, T, step)
     ]
     ref = jnp.concatenate(parts, axis=1)
 
@@ -47,12 +49,16 @@ def test_blocked_causality_with_pads():
     positions[0, :n_valid] = np.arange(n_valid)
 
     out = M._attention(q, k, v, jnp.asarray(positions))
-    # future KV must not influence a query: perturb keys past the last
-    # valid position and check valid outputs are unchanged
-    k2 = k.at[:, n_valid:].add(100.0)
-    v2 = v.at[:, n_valid:].add(100.0)
-    out2 = M._attention(q, k2, v2, jnp.asarray(positions))
-    np.testing.assert_allclose(
-        np.asarray(out[:, :n_valid]), np.asarray(out2[:, :n_valid]),
-        rtol=1e-5, atol=1e-5,
-    )
+    # strict causality across the block boundary: perturbing the key at
+    # VALID slot p must leave every query at position < p unchanged —
+    # this catches a mask computed from block-local indices, which the
+    # past-the-end perturbation alone would miss
+    for p in (1, M._Q_BLOCK - 1, M._Q_BLOCK, n_valid - 1, n_valid):
+        k2 = k.at[:, p:].add(100.0)
+        v2 = v.at[:, p:].add(100.0)
+        out2 = M._attention(q, k2, v2, jnp.asarray(positions))
+        np.testing.assert_allclose(
+            np.asarray(out[:, :min(p, n_valid)]),
+            np.asarray(out2[:, :min(p, n_valid)]),
+            rtol=1e-5, atol=1e-5, err_msg=f"leak before slot {p}",
+        )
