@@ -124,13 +124,18 @@ class _BatchingFetcher:
                 got, err = [], e
             for (loop, batch, handles, fut), (a, b) in zip(group, spans):
                 if err is not None:
-                    loop.call_soon_threadsafe(_fut_set, fut, None, err)
-                    continue
+                    res, exc = None, err
+                else:
+                    try:
+                        res, exc = self._unpack(batch, handles, got[a:b]), None
+                    except Exception as e:
+                        res, exc = None, e
                 try:
-                    res = self._unpack(batch, handles, got[a:b])
-                    loop.call_soon_threadsafe(_fut_set, fut, res, None)
-                except Exception as e:
-                    loop.call_soon_threadsafe(_fut_set, fut, None, e)
+                    loop.call_soon_threadsafe(_fut_set, fut, res, exc)
+                except RuntimeError:
+                    # the loop closed under us (engine torn down mid-flight);
+                    # keep draining so the remaining futures get resolved
+                    pass
             if stop:
                 return
 
@@ -1326,12 +1331,17 @@ class InferenceEngine(EngineCore):
             }
         if deltas:
             self._ap_apply_deltas(deltas)
-        # seat map: reuse the device map when all scheduled slots already
-        # hold seats (dead seats idle at vu=0); rebuild + upload otherwise
+        # seat map: reuse the device map only when the LIVE seats it holds
+        # are exactly the scheduled set. Dead seats idle at vu=0, but a
+        # LIVE slot the scheduler skipped this round (pool pressure) must
+        # not keep its column — the window would advance its device pos/ring
+        # token K steps behind the host mirror's back. Rebuild + upload
+        # excludes it; its device state is untouched until re-scheduled.
         needed = [r.slot for r in rows]
         B = _bucket(len(needed), cfg.decode_buckets)
+        live = {s for s in self._ap_cols if s in self._ap}
         if (self._ap_rows_dev is None or len(self._ap_cols) != B
-                or not set(needed) <= set(self._ap_cols)):
+                or live != set(needed)):
             trash = cfg.max_num_seqs
             cols = list(needed) + [trash] * (B - len(needed))
             arr = np.asarray(cols, np.int32)

@@ -740,10 +740,17 @@ def raw_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
             outs.append(s)
             tok, pos = s[:, None], pos + 1
         samples = jnp.stack(outs)                            # [K, B]
-        # write each row's last in-capacity sample back to its ring slot
-        acc = jnp.clip(valid_until - positions[:, 0], 1, K)  # [B]
-        final = jnp.take_along_axis(samples, (acc - 1)[None, :], axis=0)[0]
-        last_tok = last_tok.at[slot_ids].set(final)
+        # write each row's last in-capacity sample back to its ring slot; a
+        # row already at/over capacity (acc == 0 — e.g. a padding row whose
+        # valid_until <= pos) produced ONLY garbage samples, so route its
+        # write to the trash slot S instead of corrupting a live ring entry
+        acc = jnp.clip(valid_until - positions[:, 0], 0, K)  # [B]
+        final = jnp.take_along_axis(
+            samples, jnp.maximum(acc - 1, 0)[None, :], axis=0
+        )[0]
+        S = last_tok.shape[0] - 1
+        write_slots = jnp.where(acc > 0, slot_ids, S)
+        last_tok = last_tok.at[write_slots].set(final)
         return cache, last_tok, samples
 
     return window

@@ -51,6 +51,22 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="seconds between frontend_stats publishes for the planner "
              "(0 disables)",
     )
+    p.add_argument(
+        "--max-concurrent-requests", type=int, default=None,
+        help="admission control: concurrent requests before queueing "
+             "(default from DYNTPU_MAX_CONCURRENT_REQUESTS; 0/unset "
+             "disables)",
+    )
+    p.add_argument(
+        "--max-queued-requests", type=int, default=None,
+        help="admission queue depth beyond which requests are shed with "
+             "429 + Retry-After",
+    )
+    p.add_argument(
+        "--request-timeout", type=float, default=None,
+        help="per-request deadline in seconds, propagated end-to-end to "
+             "workers (default from DYNTPU_REQUEST_TIMEOUT_S)",
+    )
     return p.parse_args(argv)
 
 
@@ -63,8 +79,20 @@ async def run_frontend(args: argparse.Namespace) -> None:
     runtime = await DistributedRuntime.from_settings(config)
 
     manager = ModelManager()
+    max_concurrent = (args.max_concurrent_requests
+                      if args.max_concurrent_requests is not None
+                      else config.max_concurrent_requests)
+    max_queued = (args.max_queued_requests
+                  if args.max_queued_requests is not None
+                  else config.max_queued_requests)
+    timeout_s = (args.request_timeout if args.request_timeout is not None
+                 else config.request_timeout_s)
     service = HttpService(
         manager, host=args.host, port=args.port, metrics=runtime.metrics,
+        max_concurrent_requests=max_concurrent if max_concurrent else None,
+        max_queued_requests=max_queued,
+        request_timeout_s=timeout_s if timeout_s and timeout_s > 0 else None,
+        retry_after_s=config.retry_after_s,
     )
     clients = {}
     kv_routers = {}

@@ -21,11 +21,104 @@ from ..llm import openai as oai
 from ..llm.protocols import BackendOutput
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
-from ..runtime.transport import EngineError
+from ..runtime.transport import ERR_TIMEOUT, EngineError
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
 
 log = get_logger("frontend.http")
+
+# per-request deadline override (milliseconds); clamped to the service's
+# configured ceiling so a client cannot buy unbounded worker time
+TIMEOUT_HEADER = "X-Request-Timeout-Ms"
+
+
+class AdmissionError(Exception):
+    """Request shed by admission control → HTTP status + Retry-After."""
+
+    def __init__(self, status: int, retry_after_s: float, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Concurrency/queue-depth limiter: shed doomed work at the door.
+
+    Up to ``max_concurrency`` requests run; the next ``max_queue`` wait
+    their turn (bounded by the request deadline); everything beyond that is
+    rejected immediately with 429 + ``Retry-After`` instead of being
+    accepted into a melt-down (ref: the busy-threshold rejection of
+    push_router.rs:58-63, lifted to the frontend door).
+
+    Slot handoff: a release with waiters queued passes the slot to the
+    oldest waiter without touching the active count, so the limiter is FIFO
+    and never overshoots.
+    """
+
+    def __init__(self, max_concurrency: int, max_queue: int = 0,
+                 retry_after_s: float = 1.0):
+        self.max_concurrency = max_concurrency
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self._active = 0
+        self._queue: List[asyncio.Future] = []
+        self.num_admitted = 0
+        self.num_shed = 0
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    async def acquire(self, deadline: Optional[float] = None) -> None:
+        if self._active < self.max_concurrency:
+            self._active += 1
+            self.num_admitted += 1
+            return
+        if len(self._queue) >= self.max_queue:
+            self.num_shed += 1
+            raise AdmissionError(
+                429, self.retry_after_s,
+                f"admission queue full ({self._active} active, "
+                f"{len(self._queue)} queued)",
+            )
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.append(fut)
+        timeout = None
+        if deadline is not None:
+            timeout = max(deadline - time.monotonic(), 0.001)
+        try:
+            await asyncio.wait_for(fut, timeout)
+            self.num_admitted += 1
+        except asyncio.TimeoutError:
+            self._discard(fut)
+            self.num_shed += 1
+            raise AdmissionError(
+                503, self.retry_after_s,
+                "deadline expired while queued for admission",
+            ) from None
+        except asyncio.CancelledError:
+            self._discard(fut)
+            if fut.done() and not fut.cancelled():
+                self.release()  # the slot was already handed to us
+            raise
+
+    def _discard(self, fut: asyncio.Future) -> None:
+        try:
+            self._queue.remove(fut)
+        except ValueError:
+            pass
+
+    def release(self) -> None:
+        while self._queue:
+            fut = self._queue.pop(0)
+            if not fut.done():
+                fut.set_result(None)  # slot handed over; active unchanged
+                return
+        self._active -= 1
 
 
 @dataclass
@@ -126,10 +219,20 @@ class HttpService:
         metrics: Optional[MetricsRegistry] = None,
         host: str = "0.0.0.0",
         port: int = 8000,
+        max_concurrent_requests: Optional[int] = None,
+        max_queued_requests: int = 16,
+        request_timeout_s: Optional[float] = None,
+        retry_after_s: float = 1.0,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
+        self.request_timeout_s = request_timeout_s
+        self.admission: Optional[AdmissionController] = None
+        if max_concurrent_requests is not None:
+            self.admission = AdmissionController(
+                max_concurrent_requests, max_queued_requests, retry_after_s
+            )
         self.metrics = metrics or MetricsRegistry(prefix="dynamo_frontend")
         m = self.metrics
         self._m_requests = m.counter(
@@ -137,6 +240,19 @@ class HttpService:
         )
         self._m_inflight = m.gauge(
             "http_inflight", "in-flight requests", ["model"]
+        )
+        self._m_shed = m.counter(
+            "admission_shed_total", "requests shed by admission control",
+            ["endpoint", "status"],
+        )
+        self._m_admitted = m.counter(
+            "admission_admitted_total", "requests admitted", ["endpoint"]
+        )
+        self._m_queue_depth = m.gauge(
+            "admission_queue_depth", "requests waiting for an admission slot"
+        )
+        self._m_active = m.gauge(
+            "admission_active", "requests holding an admission slot"
         )
         self._m_ttft = m.histogram(
             "ttft_seconds", "time to first token", ["model"]
@@ -183,6 +299,58 @@ class HttpService:
         if self._runner:
             await self._runner.cleanup()
             self._runner = None
+
+    # ----------------------- admission / deadlines ----------------------
+
+    def _request_ctx(self, request: web.Request) -> Context:
+        """Context carrying the request deadline: the configured ceiling,
+        tightened (never widened) by an ``X-Request-Timeout-Ms`` header."""
+        timeout_s = self.request_timeout_s
+        hdr = request.headers.get(TIMEOUT_HEADER)
+        if hdr is not None:
+            try:
+                asked = float(hdr) / 1000.0
+            except ValueError:
+                asked = 0.0
+            if asked > 0:
+                timeout_s = asked if timeout_s is None else min(asked, timeout_s)
+        return Context.with_timeout(timeout_s)
+
+    async def _admit(
+        self, endpoint: str, model: str, ctx: Context
+    ) -> Optional[web.Response]:
+        """Acquire an admission slot; a Response means the request was shed."""
+        if self.admission is None:
+            return None
+        try:
+            await self.admission.acquire(deadline=ctx.deadline)
+        except AdmissionError as e:
+            self._m_shed.labels(endpoint=endpoint, status=str(e.status)).inc()
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status=str(e.status)
+            ).inc()
+            self._m_queue_depth.set(self.admission.queue_depth)
+            return web.json_response(
+                {"error": {"message": str(e), "type": "overloaded_error"}},
+                status=e.status,
+                headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
+            )
+        self._m_admitted.labels(endpoint=endpoint).inc()
+        self._m_queue_depth.set(self.admission.queue_depth)
+        self._m_active.set(self.admission.active)
+        return None
+
+    def _release(self) -> None:
+        if self.admission is not None:
+            self.admission.release()
+            self._m_queue_depth.set(self.admission.queue_depth)
+            self._m_active.set(self.admission.active)
+
+    @staticmethod
+    def _engine_status(e: EngineError) -> int:
+        if e.code == ERR_TIMEOUT:
+            return 504
+        return 503 if e.code in ("unavailable", "overloaded") else 500
 
     # --------------------------- routes --------------------------------
 
@@ -233,6 +401,10 @@ class HttpService:
                 400, f"model {model!r} does not support embeddings",
                 model, endpoint,
             )
+        ctx = self._request_ctx(request)
+        shed = await self._admit(endpoint, model, ctx)
+        if shed is not None:
+            return shed
         self._m_inflight.labels(model=model).inc()
         t0 = time.monotonic()
         try:
@@ -251,14 +423,14 @@ class HttpService:
                           "total_tokens": prompt_tokens},
             })
         except EngineError as e:
-            code = 503 if e.code in ("unavailable", "overloaded") else 500
-            return self._err(code, str(e), model, endpoint)
+            return self._err(self._engine_status(e), str(e), model, endpoint)
         except ValueError as e:
             return self._err(400, str(e), model, endpoint)
         except Exception:
             log.exception("embeddings request failed")
             return self._err(500, "internal error", model, endpoint)
         finally:
+            self._release()
             self._m_inflight.labels(model=model).dec()
             self._m_duration.labels(model=model).observe(
                 time.monotonic() - t0
@@ -284,7 +456,10 @@ class HttpService:
         if not entry.chat:
             return self._err(400, f"model {model!r} does not support chat",
                              model, endpoint)
-        ctx = Context()
+        ctx = self._request_ctx(request)
+        shed = await self._admit(endpoint, model, ctx)
+        if shed is not None:
+            return shed
         rid = oai.response_id()
         stream_mode = bool(body.get("stream", False))
         self._m_inflight.labels(model=model).inc()
@@ -306,8 +481,7 @@ class HttpService:
             ).inc()
             return web.json_response(oai.chat_to_response(agg, rid, model))
         except EngineError as e:
-            code = 503 if e.code in ("unavailable", "overloaded") else 500
-            return self._err(code, str(e), model, endpoint)
+            return self._err(self._engine_status(e), str(e), model, endpoint)
         except ValueError as e:
             return self._err(400, str(e), model, endpoint)
         except asyncio.CancelledError:
@@ -317,6 +491,7 @@ class HttpService:
             log.exception("request %s failed", rid)
             return self._err(500, "internal error", model, endpoint)
         finally:
+            self._release()
             self._m_inflight.labels(model=model).dec()
             self._m_duration.labels(model=model).observe(
                 time.monotonic() - t0
@@ -353,7 +528,8 @@ class HttpService:
                 "error", {"error": {"message": str(e), "code": e.code}}
             ).encode())
             self._m_requests.labels(
-                model=model, endpoint=endpoint, status="503"
+                model=model, endpoint=endpoint,
+                status=str(self._engine_status(e)),
             ).inc()
         with _suppress():
             await resp.write_eof()
@@ -383,7 +559,10 @@ class HttpService:
         if kind == "completion" and not entry.completions:
             return self._err(400, f"{model!r} does not support completions", model, endpoint)
 
-        ctx = Context()
+        ctx = self._request_ctx(request)
+        shed = await self._admit(endpoint, model, ctx)
+        if shed is not None:
+            return shed
         rid = oai.chat_id() if kind == "chat" else oai.completion_id()
         stream_mode = bool(body.get("stream", False))
         self._m_inflight.labels(model=model).inc()
@@ -405,8 +584,7 @@ class HttpService:
             self._m_requests.labels(model=model, endpoint=endpoint, status="200").inc()
             return web.json_response(result)
         except EngineError as e:
-            code = 503 if e.code in ("unavailable", "overloaded") else 500
-            return self._err(code, str(e), model, endpoint)
+            return self._err(self._engine_status(e), str(e), model, endpoint)
         except ValueError as e:
             return self._err(400, str(e), model, endpoint)
         except asyncio.CancelledError:
@@ -416,6 +594,7 @@ class HttpService:
             log.exception("request %s failed", rid)
             return self._err(500, "internal error", model, endpoint)
         finally:
+            self._release()
             self._m_inflight.labels(model=model).dec()
             self._m_duration.labels(model=model).observe(time.monotonic() - t0)
 
@@ -446,7 +625,10 @@ class HttpService:
             await resp.write(oai.sse_frame(
                 {"error": {"message": str(e), "code": e.code}}
             ).encode())
-            self._m_requests.labels(model=model, endpoint=endpoint, status="503").inc()
+            self._m_requests.labels(
+                model=model, endpoint=endpoint,
+                status=str(self._engine_status(e)),
+            ).inc()
         with _suppress():
             await resp.write_eof()
         return resp

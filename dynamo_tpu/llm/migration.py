@@ -5,16 +5,23 @@ Role-equivalent to the reference's ``Migration``/``RetryManager``
 no worker is available at issue time), the request is re-issued to another
 instance with the tokens generated so far appended to the prompt, so
 generation continues seamlessly. Bounded by ``migration_limit`` from the
-model card.
+model card AND by the request's remaining deadline budget: each retry waits
+a jittered exponential backoff clipped to what is left of the deadline, and
+an expired deadline surfaces as a non-retryable ``ERR_TIMEOUT`` instead of
+burning further attempts on work the client will never see.
 """
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator
+import asyncio
+import random
+from typing import Any, AsyncIterator, Optional
 
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
-from ..runtime.transport import EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE
+from ..runtime.transport import (
+    EngineError, ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE,
+)
 from ..utils.logging import get_logger
 
 log = get_logger("migration")
@@ -25,9 +32,43 @@ RETRYABLE = (ERR_UNAVAILABLE, ERR_OVERLOADED)
 class Migration(AsyncEngine):
     """Wraps the routing sink; retries with accumulated-token carryover."""
 
-    def __init__(self, sink: AsyncEngine, migration_limit: int = 3):
+    def __init__(
+        self,
+        sink: AsyncEngine,
+        migration_limit: int = 3,
+        *,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        rng: Optional[random.Random] = None,
+    ):
         self.sink = sink
         self.migration_limit = migration_limit
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        # injectable for deterministic jitter in tests
+        self.rng = rng or random.Random()
+
+    async def _backoff(self, attempt: int, context: Context) -> bool:
+        """Sleep the jittered backoff for retry number ``attempt`` (1-based),
+        clipped to the remaining deadline budget. Returns False when the
+        budget is exhausted or the caller cancelled — do not re-issue."""
+        delay = min(
+            self.backoff_base_s * (2 ** (attempt - 1)), self.backoff_cap_s
+        ) * (0.5 + 0.5 * self.rng.random())
+        remaining = context.time_remaining()
+        if remaining is not None:
+            if remaining <= 0:
+                return False
+            # never sleep past the deadline; leave a sliver to actually run
+            delay = min(delay, max(remaining - 0.001, 0.0))
+        if delay > 0:
+            # a cancel during backoff must exit immediately, not re-issue
+            # after the nap
+            try:
+                await asyncio.wait_for(context.wait_stopped(), timeout=delay)
+            except asyncio.TimeoutError:
+                pass
+        return not context.is_stopped() and not context.is_expired()
 
     async def generate(
         self, request: Any, context: Context
@@ -36,13 +77,13 @@ class Migration(AsyncEngine):
         orig_prompt_len = len(req.get("token_ids", []))
         emitted: list = []
         attempts_left = self.migration_limit
+        attempt = 0
         while True:
-            got_any_this_attempt = False
+            stream = self.sink.generate(req, context.child())
             try:
-                async for item in self.sink.generate(req, context.child()):
+                async for item in stream:
                     toks = list(item.get("token_ids", []))
                     emitted.extend(toks)
-                    got_any_this_attempt = True
                     # report the *original* prompt length even after
                     # carryover re-issue (ref: migration.rs track_response)
                     if item.get("num_prompt_tokens", 0) > orig_prompt_len:
@@ -57,10 +98,24 @@ class Migration(AsyncEngine):
                     return
                 raise EngineError("stream ended early", ERR_UNAVAILABLE)
             except EngineError as e:
-                if (e.code not in RETRYABLE or attempts_left <= 0
-                        or context.is_stopped()):
+                if context.is_stopped():
+                    return  # client gone — nobody is listening for a retry
+                if e.code not in RETRYABLE or attempts_left <= 0:
                     raise
+                if context.is_expired():
+                    raise EngineError(
+                        f"deadline exhausted after {attempt} migrations "
+                        f"({len(emitted)} tokens emitted): {e}", ERR_TIMEOUT,
+                    )
                 attempts_left -= 1
+                attempt += 1
+                if not await self._backoff(attempt, context):
+                    if context.is_stopped():
+                        return
+                    raise EngineError(
+                        f"deadline exhausted during migration backoff "
+                        f"(attempt {attempt}): {e}", ERR_TIMEOUT,
+                    )
                 log.warning(
                     "stream failed (%s); migrating with %d carried tokens "
                     "(%d attempts left)", e.code, len(emitted), attempts_left,
@@ -73,6 +128,9 @@ class Migration(AsyncEngine):
                 if remaining <= 0:
                     return  # everything already generated
                 req["max_tokens"] = remaining
-                # re-issue loop continues; tiny guard against hot-looping on
-                # instantly-failing instances is the attempt bound itself
-                _ = got_any_this_attempt
+            finally:
+                # close the sink stream deterministically — returning from
+                # the async-for (e.g. on the finished item) would otherwise
+                # leave the sink's cleanup (breaker bookkeeping, load
+                # accounting) to run at GC time
+                await stream.aclose()

@@ -16,6 +16,7 @@ from typing import Any, AsyncIterator, Dict, Optional, Set
 
 import msgpack
 
+from ..runtime.circuit import CircuitBreakerRegistry
 from ..runtime.component import Client, Component
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
@@ -51,6 +52,7 @@ class KvRouter:
         config: Optional[KvRouterConfig] = None,
         use_events: bool = True,
         seed: Optional[int] = None,
+        breakers: Optional[CircuitBreakerRegistry] = None,
     ):
         self.client = client
         self.component = component
@@ -59,6 +61,9 @@ class KvRouter:
         self.indexer = KvIndexer(block_size) if use_events else None
         self.approx = None if use_events else ApproxKvIndexer(block_size)
         self.loads = PotentialLoads(block_size)
+        # per-worker circuit breakers: tripped workers are skipped during
+        # selection until their half-open probe succeeds
+        self.breakers = breakers or CircuitBreakerRegistry()
         # worker_id -> latest ForwardPassMetrics snapshot (kv_usage, queue
         # depths) from the load_metrics subject; drives busy-threshold
         # rejection (ref: push_router.rs:58-63)
@@ -331,6 +336,7 @@ class KvRouter:
             self.approx.remove_worker(worker_id)
         self.loads.remove_worker(worker_id)
         self.worker_stats.pop(worker_id, None)
+        self.breakers.remove(worker_id)
 
     # -- routing (ref: kv_router.rs:291 find_best_match) --
 
@@ -348,6 +354,17 @@ class KvRouter:
                 f"no instances for {self.client.endpoint.path}",
                 ERR_UNAVAILABLE,
             )
+        # circuit-breaker filter: a tripped worker takes no traffic until its
+        # open timeout elapses, then at most half_open_probes requests probe
+        # it (allow() is non-mutating — the probe slot is reserved by begin()
+        # only for the worker actually selected)
+        admitted = [w for w in workers if self.breakers.allow(w)]
+        if not admitted:
+            raise EngineError(
+                f"all {len(workers)} workers circuit-open",
+                ERR_UNAVAILABLE,
+            )
+        workers = admitted
         # busy-threshold rejection (ref: push_router.rs:58-63): drop workers
         # whose published KV usage exceeds the threshold; if every worker is
         # saturated, reject so the frontend returns 503 instead of queueing
@@ -373,6 +390,7 @@ class KvRouter:
             self.config, overlap_weight=overlap_weight,
             temperature=temperature, rng=self._rng,
         )
+        self.breakers.begin(sel.worker_id)
         self.loads.add(request_id, sel.worker_id, len(token_ids),
                        sel.overlap_blocks)
         self._sync_emit("add", request_id, sel.worker_id, len(token_ids),
@@ -423,6 +441,7 @@ class KvPushRouter(AsyncEngine):
             temperature=hints.get("router_temperature"),
         )
         first = True
+        healthy = False
         try:
             async for item in self.router.client.direct(
                 sel.worker_id, request, context
@@ -430,6 +449,21 @@ class KvPushRouter(AsyncEngine):
                 if first:
                     self.router.prefill_done(context.id)
                     first = False
+                # any delivered frame proves the worker is alive; consumers
+                # (e.g. Migration) may close this generator right after the
+                # finished item, so success must not wait for exhaustion
+                healthy = True
                 yield item
+        except EngineError as e:
+            # only transport-level unavailability feeds the breaker;
+            # overload/timeouts are load signals, not worker death, and
+            # tripping on them would shrink capacity exactly when it is
+            # most needed
+            if e.code == ERR_UNAVAILABLE:
+                healthy = False
+                self.router.breakers.record_failure(sel.worker_id)
+            raise
         finally:
+            if healthy:
+                self.router.breakers.record_success(sel.worker_id)
             self.router.free(context.id)

@@ -1,0 +1,171 @@
+"""Deterministic fault injection for resilience tests.
+
+A seeded :class:`FaultPlan` describes *where* (a named hook site), *when*
+(after the Nth pass, for M firings, or with a seeded probability) and *what*
+(drop the connection, delay a frame, truncate the stream, reject with an
+error code) goes wrong. Transport and store hook points consult the
+installed plan on every pass; with no plan installed the checks are a single
+``None`` comparison, so production paths pay nothing.
+
+Sites wired in this repo (the ``key`` each site passes):
+
+==========================  =============================================
+site                        key
+==========================  =============================================
+``client.connect``          worker ``host:port`` the router dials
+``client.send``             worker ``host:port`` a request is pushed to
+``worker.admit``            request id arriving at the ingress server
+``worker.stream``           request id, checked before each data frame
+``store.call``              store op name (``put``, ``publish``, …)
+==========================  =============================================
+
+Kinds and how sites interpret them:
+
+- ``drop``      — fail the operation as a connection error (retryable
+  ``ERR_UNAVAILABLE`` on the transport, ``StoreError`` on the store).
+- ``reject``    — refuse with ``code`` (default ``ERR_OVERLOADED``).
+- ``delay``     — ``await asyncio.sleep(delay_s)`` then proceed (slow
+  worker / slow store).
+- ``truncate``  — worker-side only: abruptly close the response connection
+  mid-stream, exactly what a crashing worker looks like to the router.
+
+Determinism: each rule fires on its own per-rule pass counter
+(``after`` ≤ pass-index < ``after + times``), and probabilistic rules draw
+from the plan's seeded RNG — identical call order ⇒ identical faults.
+
+Usage in tests::
+
+    plan = FaultPlan(seed=0)
+    plan.truncate_stream("worker.stream", after=3)   # crash on the 4th frame
+    install(plan)
+    try:
+        ...drive the stack...
+    finally:
+        clear()
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from random import Random
+from typing import List, Optional
+
+DROP = "drop"
+REJECT = "reject"
+DELAY = "delay"
+TRUNCATE = "truncate"
+
+
+@dataclass
+class FaultRule:
+    site: str
+    kind: str
+    match: Optional[str] = None    # substring of the site key; None = any
+    after: int = 0                 # matching passes to let through first
+    times: Optional[int] = None    # firings before the rule burns out (None = forever)
+    delay_s: float = 0.0
+    code: str = "overloaded"       # reject code (transport error code)
+    prob: float = 1.0              # per-pass firing probability (plan RNG)
+    seen: int = field(default=0, compare=False)   # matching passes observed
+    fired: int = field(default=0, compare=False)  # times actually fired
+
+
+@dataclass
+class FaultEvent:
+    """One firing, recorded on the plan for post-hoc assertions."""
+
+    site: str
+    key: str
+    kind: str
+
+
+class FaultPlan:
+    """A seeded set of fault rules plus a log of every firing."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = Random(seed)
+        self.rules: List[FaultRule] = []
+        self.log: List[FaultEvent] = []
+
+    # -- builders --
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        self.rules.append(rule)
+        return self
+
+    def drop_connection(self, site: str, match: Optional[str] = None,
+                        after: int = 0, times: Optional[int] = None,
+                        prob: float = 1.0) -> "FaultPlan":
+        return self.add(FaultRule(site, DROP, match, after, times, prob=prob))
+
+    def reject(self, site: str, match: Optional[str] = None,
+               after: int = 0, times: Optional[int] = None,
+               code: str = "overloaded") -> "FaultPlan":
+        return self.add(FaultRule(site, REJECT, match, after, times, code=code))
+
+    def delay(self, site: str, delay_s: float, match: Optional[str] = None,
+              after: int = 0, times: Optional[int] = None) -> "FaultPlan":
+        return self.add(FaultRule(site, DELAY, match, after, times,
+                                  delay_s=delay_s))
+
+    def truncate_stream(self, site: str = "worker.stream",
+                        match: Optional[str] = None, after: int = 0,
+                        times: Optional[int] = 1) -> "FaultPlan":
+        return self.add(FaultRule(site, TRUNCATE, match, after, times))
+
+    # -- evaluation --
+
+    def check(self, site: str, key: str = "") -> Optional[FaultRule]:
+        """First rule that fires at this (site, key) pass, advancing the
+        per-rule pass counters. At most one rule fires per pass."""
+        for rule in self.rules:
+            if rule.site != site:
+                continue
+            if rule.match is not None and rule.match not in key:
+                continue
+            idx = rule.seen
+            rule.seen += 1
+            if idx < rule.after:
+                continue
+            if rule.times is not None and rule.fired >= rule.times:
+                continue
+            if rule.prob < 1.0 and self.rng.random() >= rule.prob:
+                continue
+            rule.fired += 1
+            self.log.append(FaultEvent(site, key, rule.kind))
+            return rule
+        return None
+
+    def fired(self, site: Optional[str] = None) -> int:
+        return sum(1 for e in self.log if site is None or e.site == site)
+
+
+# The active plan is process-global: the test harness owns the whole stack
+# (frontend, router, workers) in one process, so a single installation
+# covers every layer.
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active(site: str, key: str = "") -> Optional[FaultRule]:
+    """Hook-site entry point: None when no plan is installed (fast path)."""
+    if _PLAN is None:
+        return None
+    return _PLAN.check(site, key)
+
+
+async def maybe_delay(rule: Optional[FaultRule]) -> Optional[FaultRule]:
+    """Apply a delay rule in place (returns the rule for further handling)."""
+    if rule is not None and rule.kind == DELAY and rule.delay_s > 0:
+        await asyncio.sleep(rule.delay_s)
+    return rule

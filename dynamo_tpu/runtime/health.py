@@ -45,9 +45,14 @@ class HealthCheckManager:
     """Runs canary probes for registered targets on a shared schedule."""
 
     def __init__(self, config: Optional[HealthCheckConfig] = None,
-                 on_unhealthy: Optional[Callable[[str], None]] = None):
+                 on_unhealthy: Optional[Callable[[str], None]] = None,
+                 on_recovered: Optional[Callable[[str], None]] = None):
         self.config = config or HealthCheckConfig()
         self.on_unhealthy = on_unhealthy
+        # fires on the unhealthy→healthy flip; a router wires these two into
+        # its breaker registry (trip / record_success) so canary state and
+        # routing agree
+        self.on_recovered = on_recovered
         self._targets: Dict[str, ProbeFn] = {}
         self.states: Dict[str, TargetState] = {}
         self._task: Optional[asyncio.Task] = None
@@ -121,6 +126,8 @@ class HealthCheckManager:
         if not state.healthy:
             log.info("target %s recovered", name)
             state.healthy = True
+            if self.on_recovered is not None:
+                self.on_recovered(name)
 
 
 def engine_canary(engine, payload: Optional[dict] = None) -> ProbeFn:

@@ -40,6 +40,7 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from ..utils.logging import get_logger
+from . import faults
 
 log = get_logger("store")
 
@@ -727,6 +728,11 @@ class StoreClient:
                     fut.set_result(msg)
 
     async def _call(self, msg: dict) -> dict:
+        fault = await faults.maybe_delay(
+            faults.active("store.call", msg.get("op") or "")
+        )
+        if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+            raise StoreError(f"injected store fault on {msg.get('op')!r}")
         if self._writer is None or self._writer.is_closing():
             raise StoreError("store client not connected")
         seq = next(self._seq)

@@ -25,11 +25,13 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
 from ..utils.logging import TraceContext, get_logger
+from . import faults
 from .context import Context
 from .engine import AsyncEngine
 from .store import read_frame, write_frame
@@ -40,6 +42,11 @@ log = get_logger("transport")
 ERR_APP = "application"          # handler raised — not retryable
 ERR_UNAVAILABLE = "unavailable"  # connect failed / conn dropped — retryable
 ERR_OVERLOADED = "overloaded"    # worker rejected (busy threshold) — retryable
+ERR_TIMEOUT = "deadline_exceeded"  # request deadline hit — NOT retryable
+
+# request header carrying the remaining deadline budget in milliseconds;
+# relative (not absolute) so clocks never need to agree across hosts
+DEADLINE_HEADER = "x-deadline-ms"
 
 
 class EngineError(RuntimeError):
@@ -163,6 +170,11 @@ class IngressServer:
             await send({"t": "err", "rid": rid, "error": "draining",
                         "code": ERR_UNAVAILABLE})
             return
+        fault = faults.active("worker.admit", rid)
+        if fault is not None and fault.kind == faults.REJECT:
+            await send({"t": "err", "rid": rid,
+                        "error": "injected rejection", "code": fault.code})
+            return
         if self._max_inflight is not None and self._active >= self._max_inflight:
             await send({"t": "err", "rid": rid, "error": "worker overloaded",
                         "code": ERR_OVERLOADED})
@@ -177,13 +189,41 @@ class IngressServer:
             tp = headers.get("traceparent")
             if isinstance(tp, str):
                 trace = TraceContext.parse(tp)
+            deadline = None
+            budget_ms = headers.get(DEADLINE_HEADER)
+            if isinstance(budget_ms, (int, float)):
+                deadline = time.monotonic() + float(budget_ms) / 1000.0
             ctx = Context(request_id=headers.get("x-request-id") or rid,
-                          trace=trace)
+                          trace=trace, deadline=deadline)
             self._contexts[rid] = ctx
+            if ctx.is_expired():
+                # dead on arrival: never start generating for a request
+                # whose client has already given up
+                await send({"t": "err", "rid": rid,
+                            "error": "deadline expired before start",
+                            "code": ERR_TIMEOUT})
+                return
             request = msgpack.unpackb(msg["payload"], raw=False)
             async for item in self._engine.generate(request, ctx):
                 if ctx.is_killed():
                     break
+                if ctx.is_expired():
+                    # stop worker-side generation: free the slot, tell the
+                    # client the budget is gone (not retryable upstream)
+                    ctx.stop_generating()
+                    await send({"t": "err", "rid": rid,
+                                "error": "deadline exceeded mid-stream",
+                                "code": ERR_TIMEOUT})
+                    return
+                fault = await faults.maybe_delay(
+                    faults.active("worker.stream", rid)
+                )
+                if fault is not None and fault.kind == faults.TRUNCATE:
+                    # simulate a worker crash: the connection dies abruptly
+                    # mid-stream, taking every stream on it down
+                    ctx.kill()
+                    writer.close()
+                    return
                 await send(
                     {"t": "data", "rid": rid,
                      "payload": msgpack.packb(item, use_bin_type=True)}
@@ -253,6 +293,12 @@ class TransportClient:
     async def _get_conn(self, addr: str) -> _Conn:
         lock = self._conn_locks.setdefault(addr, asyncio.Lock())
         async with lock:
+            fault = await faults.maybe_delay(faults.active("client.connect", addr))
+            if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+                raise EngineError(
+                    f"cannot connect to worker at {addr}: injected fault",
+                    ERR_UNAVAILABLE,
+                )
             conn = self._conns.get(addr)
             if conn is not None and not conn.closed:
                 return conn
@@ -281,6 +327,11 @@ class TransportClient:
         Raises :class:`EngineError` with a retryability code — the Migration
         operator upstream decides whether to re-issue (ref: migration.rs:88).
         """
+        remaining = context.time_remaining()
+        if remaining is not None and remaining <= 0:
+            raise EngineError(
+                f"deadline expired before dispatch to {addr}", ERR_TIMEOUT
+            )
         conn = await self._get_conn(addr)
         rid = f"{context.id}-{next(self._rids)}"
         queue: asyncio.Queue = asyncio.Queue()
@@ -289,6 +340,14 @@ class TransportClient:
             "traceparent": context.trace.child().traceparent(),
             "x-request-id": context.id,
         }
+        if remaining is not None:
+            headers[DEADLINE_HEADER] = int(remaining * 1000)
+        fault = faults.active("client.send", addr)
+        if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+            conn.streams.pop(rid, None)
+            raise EngineError(
+                f"worker {addr} send failed: injected fault", ERR_UNAVAILABLE
+            )
         try:
             async with conn.write_lock:
                 write_frame(
@@ -320,7 +379,24 @@ class TransportClient:
         cancel_sent = False
         try:
             while True:
-                msg = await queue.get()
+                budget = context.time_remaining()
+                if budget is None:
+                    msg = await queue.get()
+                else:
+                    # a stalled worker must not outlive the request budget:
+                    # bound the wait by the remaining deadline, then tell
+                    # the worker to abandon the stream
+                    try:
+                        msg = await asyncio.wait_for(
+                            queue.get(), max(budget, 0.001)
+                        )
+                    except asyncio.TimeoutError:
+                        cancel_sent = True
+                        await self._send_cancel(conn, rid, True)
+                        raise EngineError(
+                            f"worker {addr} exceeded the request deadline",
+                            ERR_TIMEOUT,
+                        )
                 if msg is None:
                     raise EngineError(
                         f"worker {addr} connection dropped mid-stream",

@@ -60,6 +60,10 @@ class RuntimeConfig:
     system_port: int = 0  # 0 = disabled; /health /live /metrics server
     system_enabled: bool = False
     request_timeout_s: float = 600.0
+    # frontend admission control: 0 disables the limiter entirely
+    max_concurrent_requests: int = 0
+    max_queued_requests: int = 16
+    retry_after_s: float = 1.0
     health_check_enabled: bool = False
     health_check_period_s: float = 10.0
     lease_ttl_s: float = 10.0  # ref: transports/etcd.rs:89-95 (10 s TTL)
@@ -83,6 +87,15 @@ class RuntimeConfig:
         cfg.system_enabled = env_flag(ENV_PREFIX + "SYSTEM_ENABLED", cfg.system_enabled)
         cfg.request_timeout_s = env_float(
             ENV_PREFIX + "REQUEST_TIMEOUT_S", cfg.request_timeout_s
+        )
+        cfg.max_concurrent_requests = env_int(
+            ENV_PREFIX + "MAX_CONCURRENT_REQUESTS", cfg.max_concurrent_requests
+        )
+        cfg.max_queued_requests = env_int(
+            ENV_PREFIX + "MAX_QUEUED_REQUESTS", cfg.max_queued_requests
+        )
+        cfg.retry_after_s = env_float(
+            ENV_PREFIX + "RETRY_AFTER_S", cfg.retry_after_s
         )
         cfg.health_check_enabled = env_flag(
             ENV_PREFIX + "HEALTH_CHECK_ENABLED", cfg.health_check_enabled

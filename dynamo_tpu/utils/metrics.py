@@ -76,13 +76,19 @@ class MetricsRegistry:
     def _labelnames(self, extra: Sequence[str]) -> tuple:
         return tuple(self.const_labels.keys()) + tuple(extra)
 
+    def _bind(self, metric, extra_labels: Sequence[str]):
+        if extra_labels:
+            return _Bound(metric, self.const_labels)
+        # a metric with no labels at all cannot take .labels()
+        return metric.labels(**self.const_labels) if self.const_labels else metric
+
     def counter(self, name: str, doc: str, extra_labels: Sequence[str] = ()):
         c = self._get_or_create(Counter, name, doc, self._labelnames(extra_labels))
-        return c.labels(**self.const_labels) if not extra_labels else _Bound(c, self.const_labels)
+        return self._bind(c, extra_labels)
 
     def gauge(self, name: str, doc: str, extra_labels: Sequence[str] = ()):
         g = self._get_or_create(Gauge, name, doc, self._labelnames(extra_labels))
-        return g.labels(**self.const_labels) if not extra_labels else _Bound(g, self.const_labels)
+        return self._bind(g, extra_labels)
 
     def histogram(
         self, name: str, doc: str, extra_labels: Sequence[str] = (), buckets=LATENCY_BUCKETS
@@ -90,7 +96,7 @@ class MetricsRegistry:
         h = self._get_or_create(
             Histogram, name, doc, self._labelnames(extra_labels), buckets=buckets
         )
-        return h.labels(**self.const_labels) if not extra_labels else _Bound(h, self.const_labels)
+        return self._bind(h, extra_labels)
 
     def render(self) -> bytes:
         """Prometheus text exposition of every metric in this process scope."""

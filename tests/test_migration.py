@@ -1,5 +1,9 @@
 """Migration operator: retry with token carryover (ref migration.rs:88-190)."""
 
+import asyncio
+import random
+import time
+
 import pytest
 
 from dynamo_tpu.llm.migration import Migration
@@ -73,6 +77,58 @@ async def test_migration_non_retryable_error_propagates():
     with pytest.raises(EngineError):
         await collect(mig, {"token_ids": [1], "max_tokens": 4})
     assert len(flaky.requests) == 1
+
+
+@pytest.mark.anyio
+async def test_migration_two_consecutive_drops_keep_prompt_len():
+    """Two workers die back to back; the carryover still reports the
+    ORIGINAL prompt length and the token stream stays contiguous."""
+    flaky = FlakyEngine(fails=2, fail_after=2)
+    mig = Migration(flaky, migration_limit=3, backoff_base_s=0.001)
+    out = await collect(mig, {"token_ids": [1, 2, 3, 4], "max_tokens": 8})
+    toks = [t for o in out for t in o["token_ids"]]
+    # absolute-position payloads: any duplicate or hole would break this
+    assert toks == [1000 + 4 + i for i in range(8)]
+    assert out[-1]["finished"]
+    assert len(flaky.requests) == 3
+    # each re-issue carries everything emitted so far, budget shrinks
+    assert flaky.requests[1]["token_ids"] == [1, 2, 3, 4] + toks[:2]
+    assert flaky.requests[1]["max_tokens"] == 6
+    assert flaky.requests[2]["token_ids"] == [1, 2, 3, 4] + toks[:4]
+    assert flaky.requests[2]["max_tokens"] == 4
+    # the engine saw growing prompts, but the client never does
+    assert all(o["num_prompt_tokens"] == 4 for o in out)
+
+
+@pytest.mark.anyio
+async def test_migration_cancel_during_backoff_exits_immediately():
+    """A cancel that lands while Migration sleeps between retries must end
+    the stream right away, without re-issuing the request."""
+
+    class AlwaysDown(AsyncEngine):
+        def __init__(self):
+            self.calls = 0
+
+        async def generate(self, request, context):
+            self.calls += 1
+            raise EngineError("worker down", ERR_UNAVAILABLE)
+            yield  # pragma: no cover
+
+    eng = AlwaysDown()
+    mig = Migration(eng, migration_limit=5, backoff_base_s=2.0,
+                    backoff_cap_s=2.0, rng=random.Random(0))
+    ctx = Context()
+    task = asyncio.ensure_future(
+        collect(mig, {"token_ids": [1], "max_tokens": 4}, ctx)
+    )
+    await asyncio.sleep(0.05)       # first attempt failed, now backing off
+    assert eng.calls == 1
+    t0 = time.monotonic()
+    ctx.stop_generating()
+    out = await asyncio.wait_for(task, timeout=1.0)
+    assert time.monotonic() - t0 < 0.5   # did not sleep out the backoff
+    assert out == []
+    assert eng.calls == 1                # no re-issue after the cancel
 
 
 @pytest.mark.anyio

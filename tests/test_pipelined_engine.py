@@ -94,6 +94,41 @@ async def test_seeded_sampling_pipelined():
     assert a != c
 
 
+async def test_starved_budget_seatmap_rebuild():
+    """Token-budget and block-pool starvation force LIVE seqs to be skipped
+    in some decode rounds. A skipped-but-live seat must NOT keep its column
+    in a reused device seat map — the window kernel would advance its
+    device-side pos/ring token K steps past the host mirror, corrupting the
+    stream when the seq is scheduled again. Greedy outputs must match the
+    unstarved synchronous engine exactly."""
+    import asyncio
+
+    mc = ModelConfig.tiny()
+    reqs = [
+        dict(n_prompt=6 + i % 3, max_tokens=8 + i % 5) for i in range(6)
+    ]
+    ref_engine = InferenceEngine(mc, _cfg(1, 1), seed=0)
+    ref = [await _collect(ref_engine, _mk_req(i, **kw))
+           for i, kw in enumerate(reqs)]
+    await ref_engine.stop()
+
+    # 3 batched tokens/round vs 6 decoding seqs, 8 blocks vs ~12 needed
+    eng = InferenceEngine(
+        mc,
+        _cfg(4, 3, max_num_batched_tokens=3, num_blocks=8,
+             prefill_buckets=(8,), max_model_len=64),
+        seed=0,
+    )
+
+    async def one(i, kw):
+        await asyncio.sleep(0.005 * i)
+        return await _collect(eng, _mk_req(i, **kw))
+
+    got = await asyncio.gather(*(one(i, kw) for i, kw in enumerate(reqs)))
+    await eng.stop()
+    assert [list(g) for g in got] == ref
+
+
 async def test_many_requests_slot_churn():
     """More requests than slots, staggered arrivals: every request
     completes with the right token count and the pool drains clean."""

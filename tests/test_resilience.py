@@ -1,0 +1,548 @@
+"""End-to-end resilience: deadline propagation, load shedding, circuit
+breakers, and deterministic fault injection.
+
+The cluster fixture runs the REAL wire stack — store, discovery, ingress
+servers, pooled transport, KV router, migration — with scripted (non-JAX)
+workers, so every scenario exercises the same frames/retries/cancellation
+paths production uses while staying fast and fully deterministic (seeded
+FaultPlan + injectable clocks/rngs).
+"""
+
+import asyncio
+import random
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.service import (
+    AdmissionController, AdmissionError, HttpService, ModelEntry,
+    ModelManager,
+)
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import BackendOutput
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+from dynamo_tpu.router.scheduler import KvRouterConfig
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.circuit import (
+    BreakerConfig, CircuitBreaker, CircuitBreakerRegistry, CLOSED, HALF_OPEN,
+    OPEN,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine, FnEngine
+from dynamo_tpu.runtime.health import HealthCheckConfig, HealthCheckManager
+from dynamo_tpu.runtime.store import StoreServer
+from dynamo_tpu.runtime.transport import (
+    ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE, EngineError,
+)
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+pytestmark = [pytest.mark.anyio, pytest.mark.resilience]
+
+
+class ScriptedWorker(AsyncEngine):
+    """Deterministic token stream: value = 1000 + absolute position, so any
+    duplicated or lost token after a migration is directly visible."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.requests = []
+        self.contexts = []
+        self.exits = 0
+
+    async def generate(self, request, context):
+        self.requests.append(dict(request))
+        self.contexts.append(context)
+        try:
+            start = len(request["token_ids"])
+            n = int(request["max_tokens"])
+            for i in range(n):
+                if context.is_stopped() or context.is_expired():
+                    return
+                if self.delay_s:
+                    await asyncio.sleep(self.delay_s)
+                yield {
+                    "token_ids": [1000 + start + i],
+                    "finished": i == n - 1,
+                    "finish_reason": "length" if i == n - 1 else None,
+                    "num_prompt_tokens": start,
+                }
+        finally:
+            self.exits += 1
+
+
+@pytest.fixture
+async def cluster():
+    """store + two scripted workers on real ingress servers + a client."""
+    store = StoreServer(host="127.0.0.1", port=0)
+    await store.start()
+    cfg = RuntimeConfig(store_addr=f"127.0.0.1:{store.port}")
+    workers, serveds, runtimes = [], [], []
+    for _ in range(2):
+        rt = await DistributedRuntime.from_settings(cfg)
+        w = ScriptedWorker()
+        ep = rt.namespace("resil").component("backend").endpoint("generate")
+        serveds.append(await ep.serve_endpoint(w))
+        workers.append(w)
+        runtimes.append(rt)
+    front = await DistributedRuntime.from_settings(cfg)
+    client = await (front.namespace("resil").component("backend")
+                    .endpoint("generate").client())
+    await client.wait_for_instances(2, timeout_s=10.0)
+    yield {
+        "client": client, "workers": workers, "serveds": serveds,
+        "front": front,
+    }
+    faults.clear()
+    await client.stop()
+    await front.shutdown()
+    for rt in runtimes:
+        await rt.shutdown()
+    await store.stop()
+
+
+def _router(cluster, breakers=None, busy_threshold=None):
+    return KvRouter(
+        cluster["client"], cluster["client"].endpoint.component,
+        block_size=16, use_events=False, seed=0,
+        config=KvRouterConfig(replica_sync=False, snapshot_threshold=0,
+                              busy_threshold=busy_threshold),
+        breakers=breakers,
+    )
+
+
+def _pipeline(cluster, **mig_kw):
+    mig_kw.setdefault("backoff_base_s", 0.005)
+    mig_kw.setdefault("rng", random.Random(0))
+    router = _router(cluster)
+    return Migration(KvPushRouter(router), **mig_kw), router
+
+
+async def _collect(engine, request, ctx):
+    return [item async for item in engine.generate(request, ctx)]
+
+
+# ----------------------- crash mid-stream migration -----------------------
+
+
+async def test_crash_midstream_migrates_without_token_loss(cluster):
+    """A worker that dies mid-stream is migrated: the client sees every
+    token exactly once, and the retry carries the emitted prefix."""
+    mig, _ = _pipeline(cluster, migration_limit=2)
+    ctx = Context()
+    # crash the serving connection right before the 4th data frame
+    plan = faults.FaultPlan(seed=0)
+    plan.truncate_stream("worker.stream", match=ctx.id, after=3, times=1)
+    faults.install(plan)
+    try:
+        out = await _collect(
+            mig, {"token_ids": [1, 2, 3, 4], "max_tokens": 8}, ctx
+        )
+    finally:
+        faults.clear()
+    toks = [t for o in out for t in o["token_ids"]]
+    # prompt length 4 → absolute positions 4..11, no duplicates, no holes
+    assert toks == [1000 + 4 + i for i in range(8)]
+    assert out[-1]["finished"]
+    assert plan.fired("worker.stream") == 1
+    reqs = cluster["workers"][0].requests + cluster["workers"][1].requests
+    assert len(reqs) == 2
+    carry = max(reqs, key=lambda r: len(r["token_ids"]))
+    assert carry["token_ids"] == [1, 2, 3, 4] + toks[:3]
+    assert carry["max_tokens"] == 5
+    # the client-visible prompt length never changes across the migration
+    assert all(o["num_prompt_tokens"] == 4 for o in out)
+
+
+async def test_same_seed_same_faults(cluster):
+    """Determinism: identical plans against identical traffic fire at the
+    identical pass and produce the identical token stream."""
+    streams = []
+    for round_ in range(2):
+        mig, _ = _pipeline(cluster, migration_limit=2)
+        ctx = Context(request_id=f"det-{round_}")
+        plan = faults.FaultPlan(seed=7)
+        plan.truncate_stream("worker.stream", match=ctx.id, after=2, times=1)
+        faults.install(plan)
+        try:
+            out = await _collect(
+                mig, {"token_ids": [5, 6], "max_tokens": 6}, ctx
+            )
+        finally:
+            faults.clear()
+        streams.append([t for o in out for t in o["token_ids"]])
+        assert plan.fired() == 1
+    assert streams[0] == streams[1] == [1000 + 2 + i for i in range(6)]
+
+
+# ------------------------------ deadlines ---------------------------------
+
+
+async def test_deadline_stops_worker_and_skips_retries(cluster):
+    """An expired deadline surfaces ERR_TIMEOUT without burning migration
+    retries, and the WORKER-side context is cancelled so generation stops."""
+    for w in cluster["workers"]:
+        w.delay_s = 0.08
+    mig, _ = _pipeline(cluster, migration_limit=5)
+    ctx = Context.with_timeout(0.25)
+    with pytest.raises(EngineError) as ei:
+        await _collect(mig, {"token_ids": [1], "max_tokens": 50}, ctx)
+    assert ei.value.code == ERR_TIMEOUT
+    reqs = cluster["workers"][0].requests + cluster["workers"][1].requests
+    assert len(reqs) == 1  # a timeout is not retryable
+    # the deadline crossed the wire: the ingress-side context carries it
+    wctx = (cluster["workers"][0].contexts + cluster["workers"][1].contexts)[0]
+    assert wctx.deadline is not None
+    # and the worker actually stopped generating (slot freed), promptly
+    for _ in range(100):
+        if sum(w.exits for w in cluster["workers"]) == 1:
+            break
+        await asyncio.sleep(0.01)
+    assert sum(w.exits for w in cluster["workers"]) == 1
+    assert wctx.is_stopped()
+
+
+async def test_deadline_expired_before_dispatch(cluster):
+    mig, _ = _pipeline(cluster)
+    ctx = Context(deadline=time.monotonic() - 0.01)
+    with pytest.raises(EngineError) as ei:
+        await _collect(mig, {"token_ids": [1], "max_tokens": 4}, ctx)
+    assert ei.value.code == ERR_TIMEOUT
+    assert not (cluster["workers"][0].requests
+                or cluster["workers"][1].requests)
+
+
+async def test_migration_backoff_bounded_by_deadline(cluster):
+    """With workers persistently rejecting, retries stop when the budget is
+    gone — long before the attempt limit."""
+    plan = faults.FaultPlan(seed=0)
+    plan.reject("worker.admit", code=ERR_OVERLOADED)  # every admit, forever
+    faults.install(plan)
+    mig, _ = _pipeline(cluster, migration_limit=50, backoff_base_s=0.04,
+                       backoff_cap_s=0.08)
+    ctx = Context.with_timeout(0.3)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(EngineError) as ei:
+            await _collect(mig, {"token_ids": [1], "max_tokens": 4}, ctx)
+    finally:
+        faults.clear()
+    assert ei.value.code == ERR_TIMEOUT
+    assert time.monotonic() - t0 < 2.0   # nowhere near 50 backoffs
+    assert 1 <= plan.fired("worker.admit") < 50
+
+
+# --------------------------- circuit breakers -----------------------------
+
+
+def test_breaker_state_machine_fake_clock():
+    now = [0.0]
+    b = CircuitBreaker(
+        BreakerConfig(failure_threshold=3, open_timeout_s=5.0,
+                      half_open_probes=1),
+        clock=lambda: now[0],
+    )
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED   # below threshold
+    b.record_failure()
+    assert b.state == OPEN and not b.allow()
+    now[0] += 4.9
+    assert not b.allow()
+    now[0] += 0.2              # past the open timeout → probation
+    assert b.state == HALF_OPEN and b.allow()
+    b.begin()                  # the single probe slot is taken
+    assert not b.allow()
+    b.record_failure()         # probe failed → re-open with a fresh timeout
+    assert b.state == OPEN and b.num_trips == 2
+    now[0] += 5.1
+    assert b.state == HALF_OPEN
+    b.begin()
+    b.record_success()
+    assert b.state == CLOSED and b.allow()
+
+
+async def test_breaker_diverts_and_recovers_end_to_end(cluster):
+    """Worker 1's connections are cut: one failure trips its breaker, all
+    traffic diverts to worker 2, and after the (fake-clock) open timeout a
+    single half-open probe closes the breaker again."""
+    now = [0.0]
+    reg = CircuitBreakerRegistry(
+        BreakerConfig(failure_threshold=1, open_timeout_s=30.0),
+        clock=lambda: now[0],
+    )
+    router = _router(cluster, breakers=reg, busy_threshold=0.5)
+    sink = KvPushRouter(router)
+    mig = Migration(sink, migration_limit=3, backoff_base_s=0.002,
+                    rng=random.Random(3))
+    w1_id = cluster["serveds"][0].instance.instance_id
+    w1_addr = cluster["serveds"][0].instance.addr
+    w2_id = cluster["serveds"][1].instance.instance_id
+    w1, w2 = cluster["workers"]
+
+    # phase A — trip: force routing to worker 1 (worker 2 reported busy)
+    # while its connections drop
+    plan = faults.FaultPlan(seed=0)
+    plan.drop_connection("client.connect", match=w1_addr)
+    faults.install(plan)
+    router.worker_stats[w2_id] = {"worker_id": w2_id, "kv_usage": 1.0}
+    try:
+        with pytest.raises(EngineError) as ei:
+            await _collect(sink, {"token_ids": [1], "max_tokens": 2},
+                           Context())
+        assert ei.value.code == ERR_UNAVAILABLE
+        assert reg.breaker(w1_id).state == OPEN
+        assert reg.breaker(w1_id).num_trips == 1
+
+        # phase B — divert: worker 2 back in rotation, worker 1 still open
+        router.worker_stats.pop(w2_id)
+        for i in range(3):
+            out = await _collect(
+                mig, {"token_ids": [1, 2], "max_tokens": 3}, Context()
+            )
+            assert [t for o in out for t in o["token_ids"]] == [
+                1002, 1003, 1004]
+        assert not w1.requests          # every request diverted
+        assert len(w2.requests) == 3
+        assert reg.breaker(w1_id).state == OPEN
+    finally:
+        faults.clear()
+
+    # phase C — recover: past the open timeout, the next request probes
+    # worker 1 (worker 2 busy again to make the selection deterministic)
+    now[0] += 31.0
+    assert reg.breaker(w1_id).state == HALF_OPEN
+    router.worker_stats[w2_id] = {"worker_id": w2_id, "kv_usage": 1.0}
+    out = await _collect(mig, {"token_ids": [9], "max_tokens": 2}, Context())
+    assert [t for o in out for t in o["token_ids"]] == [1001, 1002]
+    assert len(w1.requests) == 1        # the probe landed on worker 1
+    assert reg.breaker(w1_id).state == CLOSED
+    router.worker_stats.pop(w2_id)
+
+
+async def test_all_breakers_open_raises_unavailable(cluster):
+    reg = CircuitBreakerRegistry(BreakerConfig(open_timeout_s=60.0))
+    router = _router(cluster, breakers=reg)
+    for served in cluster["serveds"]:
+        reg.trip(served.instance.instance_id, "test quarantine")
+    with pytest.raises(EngineError) as ei:
+        router.find_best_match("rid-x", [1, 2, 3])
+    assert ei.value.code == ERR_UNAVAILABLE
+    assert "circuit-open" in str(ei.value)
+
+
+async def test_health_flip_trips_and_recovery_closes():
+    """Canary unhealthy→healthy flips drive a breaker registry through the
+    manager's callbacks."""
+    reg = CircuitBreakerRegistry(BreakerConfig(open_timeout_s=60.0))
+    ok = [False]
+
+    async def probe():
+        if not ok[0]:
+            raise RuntimeError("canary failed")
+
+    mgr = HealthCheckManager(
+        HealthCheckConfig(period_s=0.01, timeout_s=0.2, failure_threshold=2),
+        on_unhealthy=lambda name: reg.trip(7, name),
+        on_recovered=lambda name: reg.record_success(7),
+    )
+    mgr.register("w7", probe)
+    mgr.start()
+    try:
+        for _ in range(200):
+            if not mgr.states["w7"].healthy:
+                break
+            await asyncio.sleep(0.01)
+        assert not mgr.states["w7"].healthy
+        assert not reg.allow(7)
+        ok[0] = True
+        for _ in range(200):
+            if mgr.states["w7"].healthy:
+                break
+            await asyncio.sleep(0.01)
+        assert mgr.states["w7"].healthy
+        assert reg.breaker(7).state == CLOSED
+    finally:
+        await mgr.stop()
+
+
+# --------------------------- admission control ----------------------------
+
+
+def _gated_entry(name, gate):
+    async def gen(request, context):
+        yield BackendOutput(token_ids=[1], text="a", cum_tokens=1,
+                            num_prompt_tokens=1)
+        await gate.wait()
+        yield BackendOutput(token_ids=[2], text="b", finish_reason="stop",
+                            cum_tokens=2, num_prompt_tokens=1)
+    return ModelEntry(name=name, engine=FnEngine(gen))
+
+
+CHAT = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+
+
+async def test_frontend_sheds_overload_with_retry_after():
+    """One slot, one queue seat: request 3 is shed 429 immediately, the
+    queued request 2 times out to 503, request 1 completes — all with
+    Retry-After, all counted in the admission metrics."""
+    gate = asyncio.Event()
+    manager = ModelManager()
+    manager.register(_gated_entry("m", gate))
+    svc = HttpService(
+        manager, host="127.0.0.1", port=0,
+        metrics=MetricsRegistry(prefix="test_resil_admission"),
+        max_concurrent_requests=1, max_queued_requests=1,
+        request_timeout_s=0.4, retry_after_s=3.0,
+    )
+    await svc.start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            t1 = asyncio.create_task(
+                s.post(f"{base}/v1/chat/completions", json=CHAT)
+            )
+            # wait until request 1 holds the slot
+            for _ in range(200):
+                if svc.admission.active == 1:
+                    break
+                await asyncio.sleep(0.005)
+            assert svc.admission.active == 1
+            t2 = asyncio.create_task(
+                s.post(f"{base}/v1/chat/completions", json=CHAT)
+            )
+            for _ in range(200):
+                if svc.admission.queue_depth == 1:
+                    break
+                await asyncio.sleep(0.005)
+            # queue full → immediate 429 + Retry-After
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=CHAT) as r3:
+                assert r3.status == 429
+                assert r3.headers["Retry-After"] == "3"
+                body = await r3.json()
+                assert body["error"]["type"] == "overloaded_error"
+            # request 2's deadline expires while queued → 503 + Retry-After
+            r2 = await t2
+            assert r2.status == 503
+            assert r2.headers["Retry-After"] == "3"
+            r2.release()
+            # request 1 was never shed
+            gate.set()
+            r1 = await t1
+            assert r1.status == 200
+            r1.release()
+        assert svc.admission.num_shed == 2
+        assert svc.admission.num_admitted == 1
+        assert svc.admission.active == 0 and svc.admission.queue_depth == 0
+        metrics = svc.metrics.render().decode()
+        assert 'admission_shed_total{endpoint="/v1/chat/completions",status="429"} 1.0' in metrics
+        assert 'admission_shed_total{endpoint="/v1/chat/completions",status="503"} 1.0' in metrics
+    finally:
+        await svc.stop()
+
+
+async def test_frontend_queue_admits_when_slot_frees():
+    """A queued request is handed the slot (FIFO) instead of being shed."""
+    gate = asyncio.Event()
+    manager = ModelManager()
+    manager.register(_gated_entry("m", gate))
+    svc = HttpService(
+        manager, host="127.0.0.1", port=0,
+        metrics=MetricsRegistry(prefix="test_resil_queue"),
+        max_concurrent_requests=1, max_queued_requests=2,
+    )
+    await svc.start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            t1 = asyncio.create_task(
+                s.post(f"{base}/v1/chat/completions", json=CHAT)
+            )
+            for _ in range(200):
+                if svc.admission.active == 1:
+                    break
+                await asyncio.sleep(0.005)
+            t2 = asyncio.create_task(
+                s.post(f"{base}/v1/chat/completions", json=CHAT)
+            )
+            for _ in range(200):
+                if svc.admission.queue_depth == 1:
+                    break
+                await asyncio.sleep(0.005)
+            gate.set()
+            r1, r2 = await t1, await t2
+            assert r1.status == 200 and r2.status == 200
+            r1.release()
+            r2.release()
+        assert svc.admission.num_shed == 0
+        assert svc.admission.num_admitted == 2
+    finally:
+        await svc.stop()
+
+
+async def test_frontend_maps_timeout_to_504():
+    async def gen(request, context):
+        raise EngineError("deadline exceeded", ERR_TIMEOUT)
+        yield  # pragma: no cover
+
+    manager = ModelManager()
+    manager.register(ModelEntry(name="m", engine=FnEngine(gen)))
+    svc = HttpService(manager, host="127.0.0.1", port=0,
+                      metrics=MetricsRegistry(prefix="test_resil_504"))
+    await svc.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{svc.port}/v1/chat/completions",
+                json=CHAT,
+            ) as r:
+                assert r.status == 504
+    finally:
+        await svc.stop()
+
+
+async def test_admission_controller_cancelled_waiter_hands_slot_on():
+    """A waiter cancelled after being handed the slot passes it to the next
+    waiter instead of leaking it."""
+    ac = AdmissionController(1, max_queue=4)
+    await ac.acquire()
+    w1 = asyncio.create_task(ac.acquire())
+    w2 = asyncio.create_task(ac.acquire())
+    await asyncio.sleep(0.01)
+    assert ac.queue_depth == 2
+    ac.release()           # hands the slot to w1's future
+    w1.cancel()
+    try:
+        await w1
+    except asyncio.CancelledError:
+        pass
+    await asyncio.wait_for(w2, 1.0)   # w2 inherits the slot
+    assert ac.active == 1
+    ac.release()
+    assert ac.active == 0
+    with pytest.raises(AdmissionError):
+        ac2 = AdmissionController(0, max_queue=0)
+        await ac2.acquire()
+
+
+# ------------------------------ store faults ------------------------------
+
+
+async def test_store_fault_injection_hits_calls(cluster):
+    from dynamo_tpu.runtime.store import StoreError
+
+    plan = faults.FaultPlan(seed=0)
+    plan.drop_connection("store.call", match="put", times=1)
+    faults.install(plan)
+    try:
+        with pytest.raises(StoreError):
+            await cluster["front"].store.put("v1/test/fault", b"x")
+        # burned out after one firing: the same call now succeeds
+        await cluster["front"].store.put("v1/test/fault", b"x")
+    finally:
+        faults.clear()
+    assert plan.fired("store.call") == 1

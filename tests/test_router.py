@@ -183,6 +183,8 @@ def test_busy_threshold_rejection():
         def instance_ids(self):
             return [1, 2]
 
+    from dynamo_tpu.runtime.circuit import CircuitBreakerRegistry
+
     router = KvRouter.__new__(KvRouter)
     router.client = FakeClient()
     router.component = None
@@ -192,6 +194,7 @@ def test_busy_threshold_rejection():
     router.approx = None
     router.loads = PotentialLoads(BS)
     router.worker_stats = {1: {"kv_usage": 0.95}, 2: {"kv_usage": 0.9}}
+    router.breakers = CircuitBreakerRegistry()
     router._rng = random.Random(0)
     with pytest.raises(EngineError) as exc:
         router.find_best_match("r1", list(range(8)))
