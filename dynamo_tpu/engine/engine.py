@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import (
@@ -24,6 +25,7 @@ from typing import (
 import jax
 import numpy as np
 
+from .. import tracing
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..utils.logging import get_logger
@@ -484,8 +486,14 @@ class EngineCore(AsyncEngine):
                        "killed" if context.is_killed() else "cancelled")
 
         watcher = asyncio.create_task(_on_stop())
+        t_submit = time.monotonic()
+        seq_ref: Optional[SchedSeq] = None
         try:
             async for out in self.submit(req):
+                if seq_ref is None:
+                    # grab the scheduler-side state before _drop can pop it;
+                    # its t_scheduled/t_first_token stamps feed the spans
+                    seq_ref = self._seqs.get(req.request_id)
                 if context.is_killed():
                     return
                 yield {
@@ -499,6 +507,28 @@ class EngineCore(AsyncEngine):
                     return
         finally:
             watcher.cancel()
+            self._record_stage_spans(context, t_submit, seq_ref)
+
+    def _record_stage_spans(
+        self, context: Context, t_submit: float, seq: Optional[SchedSeq]
+    ) -> None:
+        """Attribute engine time to worker.queue / engine.prefill /
+        engine.decode spans from the scheduler's monotonic stamps. Recorded
+        after the fact (no live span objects in the step loop) so the
+        per-token hot path carries zero tracing overhead."""
+        tracer = tracing.get_tracer()
+        end = time.monotonic()
+        t_sched = seq.t_scheduled if seq is not None else None
+        t_first = seq.t_first_token if seq is not None else None
+        tracer.record("worker.queue", context,
+                      start_mono=t_submit, end_mono=(t_sched or end))
+        if t_sched is not None:
+            tracer.record("engine.prefill", context,
+                          start_mono=t_sched, end_mono=(t_first or end))
+        if t_first is not None:
+            attrs = {"num_tokens": len(seq.output_ids)}
+            tracer.record("engine.decode", context, start_mono=t_first,
+                          end_mono=end, attrs=attrs)
 
     # ------------------------- step loop -------------------------------
 
@@ -713,6 +743,8 @@ class EngineCore(AsyncEngine):
 
     def _emit_token(self, seq: SchedSeq) -> None:
         self.num_generated_tokens += 1
+        if seq.t_first_token is None:
+            seq.t_first_token = time.monotonic()
         reason = self.scheduler.check_stop(seq)
         out = StepOutput(
             request_id=seq.seq_id,

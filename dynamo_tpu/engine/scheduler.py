@@ -206,6 +206,11 @@ class SchedSeq:
     mm_positions: Optional[list] = None
     mm_embeddings: Optional[object] = None
     arrival: float = field(default_factory=time.monotonic)
+    # tracing stamps (monotonic): first time a prefill chunk was scheduled,
+    # and when the first output token was emitted — the engine derives the
+    # worker.queue / engine.prefill / engine.decode span windows from these
+    t_scheduled: Optional[float] = None
+    t_first_token: Optional[float] = None
     status: SeqStatus = SeqStatus.WAITING
     output_ids: List[int] = field(default_factory=list)
     block_table: List[int] = field(default_factory=list)
@@ -490,6 +495,8 @@ class Scheduler:
             if not ok:
                 break
             final = start + chunk >= target
+            if seq.t_scheduled is None:
+                seq.t_scheduled = time.monotonic()
             batch.prefills.append(
                 PrefillChunk(seq=seq, start=start, length=chunk,
                              final=final)
@@ -722,6 +729,9 @@ class Scheduler:
         stored events — this worker now owns those blocks) and enter the
         decode loop."""
         seq.num_computed = seq.prompt_len
+        if seq.t_scheduled is None:
+            # remote prefill: activation is the first scheduling event
+            seq.t_scheduled = time.monotonic()
         self._seal_complete_blocks(seq)
         self._append_token(seq, first_token)
         seq.status = SeqStatus.RUNNING

@@ -17,12 +17,13 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 from aiohttp import web
 
+from .. import tracing
 from ..llm import openai as oai
 from ..llm.protocols import BackendOutput
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..runtime.transport import ERR_TIMEOUT, EngineError
-from ..utils.logging import get_logger
+from ..utils.logging import TraceContext, get_logger
 from ..utils.metrics import MetricsRegistry
 
 log = get_logger("frontend.http")
@@ -264,6 +265,9 @@ class HttpService:
             "request_seconds", "request duration", ["model"]
         )
         self.window_stats = WindowStats()
+        # stage_latency_seconds{stage=...} from trace spans, observed for
+        # every span regardless of the export sampling knob
+        tracing.get_tracer().attach_metrics(self.metrics)
         self._runner: Optional[web.AppRunner] = None
         self.app = self._build_app()
 
@@ -296,15 +300,19 @@ class HttpService:
         log.info("http frontend listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
+        tracing.get_tracer().detach_metrics(self.metrics)
         if self._runner:
             await self._runner.cleanup()
             self._runner = None
 
     # ----------------------- admission / deadlines ----------------------
 
-    def _request_ctx(self, request: web.Request) -> Context:
-        """Context carrying the request deadline: the configured ceiling,
-        tightened (never widened) by an ``X-Request-Timeout-Ms`` header."""
+    def _request_ctx(self, request: web.Request):
+        """(Context, upstream span id): the context carries the request
+        deadline — the configured ceiling, tightened (never widened) by an
+        ``X-Request-Timeout-Ms`` header — and continues an incoming W3C
+        ``traceparent`` trace when the caller sent one, so frontend spans
+        parent under the caller's span."""
         timeout_s = self.request_timeout_s
         hdr = request.headers.get(TIMEOUT_HEADER)
         if hdr is not None:
@@ -314,7 +322,14 @@ class HttpService:
                 asked = 0.0
             if asked > 0:
                 timeout_s = asked if timeout_s is None else min(asked, timeout_s)
-        return Context.with_timeout(timeout_s)
+        trace = parent = None
+        tp = request.headers.get("traceparent")
+        if tp:
+            upstream = TraceContext.parse(tp)
+            if upstream is not None:
+                trace = upstream.child()
+                parent = upstream.span_id
+        return Context.with_timeout(timeout_s, trace=trace), parent
 
     async def _admit(
         self, endpoint: str, model: str, ctx: Context
@@ -322,9 +337,12 @@ class HttpService:
         """Acquire an admission slot; a Response means the request was shed."""
         if self.admission is None:
             return None
+        span = tracing.get_tracer().start_span("frontend.admission", ctx)
         try:
             await self.admission.acquire(deadline=ctx.deadline)
         except AdmissionError as e:
+            span.set_status("error", f"shed:{e.status}")
+            span.end()
             self._m_shed.labels(endpoint=endpoint, status=str(e.status)).inc()
             self._m_requests.labels(
                 model=model, endpoint=endpoint, status=str(e.status)
@@ -335,6 +353,7 @@ class HttpService:
                 status=e.status,
                 headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
             )
+        span.end()
         self._m_admitted.labels(endpoint=endpoint).inc()
         self._m_queue_depth.set(self.admission.queue_depth)
         self._m_active.set(self.admission.active)
@@ -401,7 +420,7 @@ class HttpService:
                 400, f"model {model!r} does not support embeddings",
                 model, endpoint,
             )
-        ctx = self._request_ctx(request)
+        ctx, _upstream = self._request_ctx(request)
         shed = await self._admit(endpoint, model, ctx)
         if shed is not None:
             return shed
@@ -456,7 +475,7 @@ class HttpService:
         if not entry.chat:
             return self._err(400, f"model {model!r} does not support chat",
                              model, endpoint)
-        ctx = self._request_ctx(request)
+        ctx, _upstream = self._request_ctx(request)
         shed = await self._admit(endpoint, model, ctx)
         if shed is not None:
             return shed
@@ -559,9 +578,17 @@ class HttpService:
         if kind == "completion" and not entry.completions:
             return self._err(400, f"{model!r} does not support completions", model, endpoint)
 
-        ctx = self._request_ctx(request)
+        ctx, upstream = self._request_ctx(request)
+        # the root span ADOPTS the context's span id: every child minted via
+        # ctx.trace parents under it, across process boundaries
+        root = tracing.get_tracer().start_span(
+            "frontend.request", trace=ctx.trace, parent_span_id=upstream,
+            attrs={"model": model, "endpoint": endpoint}, root=True,
+        )
         shed = await self._admit(endpoint, model, ctx)
         if shed is not None:
+            root.set_status("error", f"shed:{shed.status}")
+            root.end()
             return shed
         rid = oai.chat_id() if kind == "chat" else oai.completion_id()
         stream_mode = bool(body.get("stream", False))
@@ -584,17 +611,22 @@ class HttpService:
             self._m_requests.labels(model=model, endpoint=endpoint, status="200").inc()
             return web.json_response(result)
         except EngineError as e:
+            root.set_status("error", e.code)
             return self._err(self._engine_status(e), str(e), model, endpoint)
         except ValueError as e:
+            root.set_status("error", "bad_request")
             return self._err(400, str(e), model, endpoint)
         except asyncio.CancelledError:
             ctx.kill()
+            root.set_status("error", "cancelled")
             raise
         except Exception:
             log.exception("request %s failed", rid)
+            root.set_status("error", "internal")
             return self._err(500, "internal error", model, endpoint)
         finally:
             self._release()
+            root.end()
             self._m_inflight.labels(model=model).dec()
             self._m_duration.labels(model=model).observe(time.monotonic() - t0)
 

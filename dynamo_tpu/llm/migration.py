@@ -22,6 +22,7 @@ from ..runtime.engine import AsyncEngine
 from ..runtime.transport import (
     EngineError, ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE,
 )
+from ..tracing import get_tracer, trace_span
 from ..utils.logging import get_logger
 
 log = get_logger("migration")
@@ -79,7 +80,16 @@ class Migration(AsyncEngine):
         attempts_left = self.migration_limit
         attempt = 0
         while True:
-            stream = self.sink.generate(req, context.child())
+            # the attempt's child context mints the span id the attempt span
+            # adopts: router/transport spans issued under attempt_ctx parent
+            # here, and each retry is a sibling under the request root
+            attempt_ctx = context.child()
+            span = get_tracer().start_span(
+                "migration.attempt", trace=attempt_ctx.trace,
+                parent_span_id=context.trace.span_id,
+                attrs={"attempt": attempt, "carried_tokens": len(emitted)},
+            )
+            stream = self.sink.generate(req, attempt_ctx)
             try:
                 async for item in stream:
                     toks = list(item.get("token_ids", []))
@@ -98,6 +108,10 @@ class Migration(AsyncEngine):
                     return
                 raise EngineError("stream ended early", ERR_UNAVAILABLE)
             except EngineError as e:
+                # close the attempt span BEFORE the backoff sleep below —
+                # the nap belongs to migration.backoff, not the attempt
+                span.set_status("error", e.code)
+                span.end()
                 if context.is_stopped():
                     return  # client gone — nobody is listening for a retry
                 if e.code not in RETRYABLE or attempts_left <= 0:
@@ -109,7 +123,10 @@ class Migration(AsyncEngine):
                     )
                 attempts_left -= 1
                 attempt += 1
-                if not await self._backoff(attempt, context):
+                with trace_span("migration.backoff", context,
+                                attrs={"attempt": attempt}):
+                    backed_off = await self._backoff(attempt, context)
+                if not backed_off:
                     if context.is_stopped():
                         return
                     raise EngineError(
@@ -134,3 +151,4 @@ class Migration(AsyncEngine):
                 # leave the sink's cleanup (breaker bookkeeping, load
                 # accounting) to run at GC time
                 await stream.aclose()
+                span.end()  # no-op on the error path (already closed)

@@ -15,6 +15,7 @@ import jinja2
 
 from ..runtime.context import Context
 from ..runtime.engine import Operator
+from ..tracing import trace_span
 from .protocols import PreprocessedRequest, SamplingOptions, StopConditions
 from .tokenizer import Tokenizer
 
@@ -87,7 +88,9 @@ class Preprocessor(Operator):
         if isinstance(request, PreprocessedRequest):
             return request
         req: dict = request
-        token_ids, formatted = self._tokenize(req)
+        with trace_span("frontend.tokenize", context) as span:
+            token_ids, formatted = self._tokenize(req)
+            span.set_attr("num_tokens", len(token_ids))
         return self.build_request(req, token_ids, formatted=formatted)
 
     def build_request(

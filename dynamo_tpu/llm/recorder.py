@@ -31,6 +31,11 @@ class StreamRecord:
 
     request_id: str
     t_start: float = field(default_factory=time.monotonic)
+    # wall-clock anchor for t_start: lets offline tooling lay records from
+    # different processes on one timeline (and join them against trace
+    # spans, which carry the same anchor)
+    t_start_unix: float = field(default_factory=time.time)
+    trace_id: Optional[str] = None
     events: List[tuple] = field(default_factory=list)  # (dt, kind, payload)
     finished: bool = False
 
@@ -78,6 +83,8 @@ class StreamRecord:
     def to_jsonl(self) -> str:
         return json.dumps({
             "request_id": self.request_id,
+            "t_start_unix": self.t_start_unix,
+            **({"trace_id": self.trace_id} if self.trace_id else {}),
             "events": [
                 {"dt": dt, "kind": kind,
                  **({"payload": payload} if payload is not None else {})}
@@ -96,16 +103,18 @@ class Recorder:
         self.capture_payloads = capture_payloads
         self.records: Dict[str, StreamRecord] = {}
 
-    def start(self, request_id: str) -> StreamRecord:
-        rec = StreamRecord(request_id=request_id)
+    def start(self, request_id: str,
+              trace_id: Optional[str] = None) -> StreamRecord:
+        rec = StreamRecord(request_id=request_id, trace_id=trace_id)
         self.records[request_id] = rec
         return rec
 
     async def record_stream(
-        self, request_id: str, stream: AsyncIterator
+        self, request_id: str, stream: AsyncIterator,
+        trace_id: Optional[str] = None,
     ) -> AsyncIterator:
         """Pass-through wrapper: timestamps every yielded item."""
-        rec = self.start(request_id)
+        rec = self.start(request_id, trace_id=trace_id)
         try:
             async for item in stream:
                 rec.mark("item", item if self.capture_payloads else None)

@@ -14,7 +14,8 @@ from __future__ import annotations
 import argparse
 import asyncio
 import signal
-from typing import Dict
+import time
+from typing import Callable, Dict
 
 import msgpack
 
@@ -28,9 +29,23 @@ log = get_logger("metrics_aggregator")
 
 
 class MetricsAggregator:
-    def __init__(self, runtime: DistributedRuntime, component: str):
+    # a worker that has not published stats for this long is gone (crashed
+    # or drained) — its gauges must disappear from the scrape, not freeze
+    # at their last values forever
+    STALE_AFTER_S = 30.0
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        component: str,
+        *,
+        stale_after_s: float = STALE_AFTER_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.runtime = runtime
         self.component = runtime.namespace().component(component)
+        self.stale_after_s = stale_after_s
+        self._clock = clock  # injectable for deterministic expiry tests
         m = runtime.metrics.child(component=component)
         self._g_usage = m.gauge(
             "worker_kv_usage", "per-worker KV usage", ["worker"]
@@ -47,7 +62,8 @@ class MetricsAggregator:
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
-        self.worker_stats: Dict[int, dict] = {}
+        self.worker_stats: Dict[str, dict] = {}
+        self._last_seen: Dict[str, float] = {}
         self._tasks = []
 
     async def start(self) -> None:
@@ -91,11 +107,29 @@ class MetricsAggregator:
     def _on_stats(self, snap: dict) -> None:
         wid = str(snap.get("worker_id", "?"))
         self.worker_stats[wid] = snap
+        self._last_seen[wid] = self._clock()
         self._g_usage.labels(worker=wid).set(snap.get("kv_usage", 0.0))
         self._g_running.labels(worker=wid).set(
             snap.get("num_requests_running", 0))
         self._g_waiting.labels(worker=wid).set(
             snap.get("num_requests_waiting", 0))
+        self.expire_stale()
+        self._recompute_hit_rate()
+
+    def expire_stale(self) -> None:
+        """Drop workers whose stats went silent past ``stale_after_s`` and
+        clear their per-worker gauge label sets from the registry."""
+        now = self._clock()
+        stale = [wid for wid, seen in self._last_seen.items()
+                 if now - seen > self.stale_after_s]
+        for wid in stale:
+            self.worker_stats.pop(wid, None)
+            self._last_seen.pop(wid, None)
+            for gauge in (self._g_usage, self._g_running, self._g_waiting):
+                gauge.remove(worker=wid)
+            log.info("expired stale worker %s from the scrape", wid)
+
+    def _recompute_hit_rate(self) -> None:
         hits = sum(s.get("prefix_cache_hits", 0)
                    for s in self.worker_stats.values())
         queries = sum(s.get("prefix_cache_queries", 0)

@@ -21,6 +21,7 @@ from ..runtime.component import Client, Component
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..runtime.transport import EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE
+from ..tracing import trace_span
 from ..utils.logging import get_logger
 from ..tokens import compute_block_hashes_for_seq
 from .indexer import ApproxKvIndexer, KvIndexer, RouterEvent
@@ -435,11 +436,14 @@ class KvPushRouter(AsyncEngine):
         token_ids = list(mm.get("hash_token_ids")
                          or request.get("token_ids", ()))
         hints: Dict[str, Any] = request.get("router_hints") or {}
-        sel = self.router.find_best_match(
-            context.id, token_ids,
-            overlap_weight=hints.get("overlap_score_weight"),
-            temperature=hints.get("router_temperature"),
-        )
+        with trace_span("router.select", context) as span:
+            sel = self.router.find_best_match(
+                context.id, token_ids,
+                overlap_weight=hints.get("overlap_score_weight"),
+                temperature=hints.get("router_temperature"),
+            )
+            span.set_attr("worker_id", sel.worker_id)
+            span.set_attr("overlap_blocks", sel.overlap_blocks)
         first = True
         healthy = False
         try:

@@ -17,6 +17,7 @@ from typing import AsyncIterator, Callable, Dict, List, Optional
 
 import msgpack
 
+from .. import tracing
 from ..utils.config import RuntimeConfig
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
@@ -72,6 +73,9 @@ class DistributedRuntime:
         # graceful endpoint shutdown also deregisters the models
         self.registered_models: List[tuple] = []
         store.on_lease_lost = self._on_lease_lost
+        # per-stage latency histograms from trace spans land in this
+        # process's registry regardless of the span-export sampling knob
+        tracing.get_tracer().attach_metrics(self.metrics)
 
     @staticmethod
     async def from_settings(
@@ -81,6 +85,14 @@ class DistributedRuntime:
         store = await StoreClient.connect(
             config.store_addr, lease_ttl_s=config.lease_ttl_s
         )
+        tracer = tracing.get_tracer()
+        tracer.configure(
+            sample_ratio=config.trace_sample_ratio,
+            slow_threshold_s=config.trace_slow_threshold_s,
+            buffer_size=config.trace_buffer_size,
+        )
+        if config.trace_export_path:
+            tracer.add_jsonl(config.trace_export_path)
         runtime = DistributedRuntime(store, config)
         if config.system_enabled:
             await runtime.start_system_server(port=config.system_port)
@@ -106,6 +118,7 @@ class DistributedRuntime:
 
     async def shutdown(self) -> None:
         self.shutdown_event.set()
+        tracing.get_tracer().detach_metrics(self.metrics)
         if self.system_server is not None:
             self.system_server.set_live(False)
             await self.system_server.stop()

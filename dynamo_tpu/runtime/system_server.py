@@ -50,6 +50,8 @@ class SystemServer:
             web.get("/health", self._health),
             web.get("/live", self._livez),
             web.get("/metrics", self._metrics),
+            web.get("/debug/traces", self._traces),
+            web.get("/debug/traces/{trace_id}", self._trace),
         ])
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -91,3 +93,23 @@ class SystemServer:
         body = self.metrics.render() if self.metrics else b""
         return web.Response(body=body, content_type="text/plain",
                             charset="utf-8")
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Recent trace ids still resident in this process's span buffer."""
+        from .. import tracing
+
+        ids = tracing.get_tracer().trace_ids()
+        return web.json_response({"trace_ids": ids, "count": len(ids)})
+
+    async def _trace(self, request: web.Request) -> web.Response:
+        """Assembled view of one trace (this process's spans only)."""
+        from .. import tracing
+        from ..tracing.assemble import assemble_trace
+
+        trace_id = request.match_info["trace_id"]
+        spans = tracing.get_tracer().get_trace(trace_id)
+        if not spans:
+            return web.json_response(
+                {"error": f"unknown trace id {trace_id!r}"}, status=404
+            )
+        return web.json_response(assemble_trace([s.to_dict() for s in spans]))

@@ -30,6 +30,7 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from .. import tracing
 from ..utils.logging import TraceContext, get_logger
 from . import faults
 from .context import Context
@@ -181,6 +182,7 @@ class IngressServer:
             return
         self._active += 1
         ctx: Optional[Context] = None
+        span = None
         try:
             headers = msg.get("headers") or {}
             if not isinstance(headers, dict):
@@ -189,16 +191,30 @@ class IngressServer:
             tp = headers.get("traceparent")
             if isinstance(tp, str):
                 trace = TraceContext.parse(tp)
+            # the worker's process-local root span adopts a child of the wire
+            # trace context, so engine spans recorded under ctx parent here
+            # while the span itself parents under the client's transport.send
+            ing_trace = trace.child() if trace is not None else None
+            span = tracing.get_tracer().start_span(
+                "worker.ingress", trace=ing_trace,
+                parent_span_id=(trace.span_id if trace is not None else None),
+                attrs={"rid": rid}, root=True,
+            )
+            if ing_trace is None:
+                ing_trace = TraceContext(
+                    trace_id=span.trace_id, span_id=span.span_id
+                )
             deadline = None
             budget_ms = headers.get(DEADLINE_HEADER)
             if isinstance(budget_ms, (int, float)):
                 deadline = time.monotonic() + float(budget_ms) / 1000.0
             ctx = Context(request_id=headers.get("x-request-id") or rid,
-                          trace=trace, deadline=deadline)
+                          trace=ing_trace, deadline=deadline)
             self._contexts[rid] = ctx
             if ctx.is_expired():
                 # dead on arrival: never start generating for a request
                 # whose client has already given up
+                span.set_status("error", "deadline_on_arrival")
                 await send({"t": "err", "rid": rid,
                             "error": "deadline expired before start",
                             "code": ERR_TIMEOUT})
@@ -211,6 +227,7 @@ class IngressServer:
                     # stop worker-side generation: free the slot, tell the
                     # client the budget is gone (not retryable upstream)
                     ctx.stop_generating()
+                    span.set_status("error", ERR_TIMEOUT)
                     await send({"t": "err", "rid": rid,
                                 "error": "deadline exceeded mid-stream",
                                 "code": ERR_TIMEOUT})
@@ -221,6 +238,7 @@ class IngressServer:
                 if fault is not None and fault.kind == faults.TRUNCATE:
                     # simulate a worker crash: the connection dies abruptly
                     # mid-stream, taking every stream on it down
+                    span.set_status("error", "injected_crash")
                     ctx.kill()
                     writer.close()
                     return
@@ -235,7 +253,11 @@ class IngressServer:
         except (ConnectionResetError, BrokenPipeError):
             if ctx is not None:
                 ctx.kill()
+            if span is not None:
+                span.set_status("error", "connection_lost")
         except EngineError as exc:
+            if span is not None:
+                span.set_status("error", exc.code)
             try:
                 await send({"t": "err", "rid": rid, "error": str(exc),
                             "code": exc.code})
@@ -243,12 +265,16 @@ class IngressServer:
                 pass
         except Exception as exc:  # noqa: BLE001
             log.exception("handler failed for request %s", rid)
+            if span is not None:
+                span.set_status("error", ERR_APP)
             try:
                 await send({"t": "err", "rid": rid, "error": str(exc),
                             "code": ERR_APP})
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:
+            if span is not None:
+                span.end()
             self._active -= 1
 
 
@@ -332,12 +358,29 @@ class TransportClient:
             raise EngineError(
                 f"deadline expired before dispatch to {addr}", ERR_TIMEOUT
             )
-        conn = await self._get_conn(addr)
+        # the wire trace context IS the transport span: the worker parses it
+        # from the traceparent header and parents its ingress span under it
+        wire = context.trace.child()
+        span = tracing.get_tracer().start_span(
+            "transport.send", trace=wire,
+            parent_span_id=context.trace.span_id, attrs={"addr": addr},
+        )
+
+        def _fail_span(code: str) -> None:
+            if not span.ended:
+                span.set_status("error", code)
+                span.end()
+
+        try:
+            conn = await self._get_conn(addr)
+        except EngineError as e:
+            _fail_span(e.code)
+            raise
         rid = f"{context.id}-{next(self._rids)}"
         queue: asyncio.Queue = asyncio.Queue()
         conn.streams[rid] = queue
         headers = {
-            "traceparent": context.trace.child().traceparent(),
+            "traceparent": wire.traceparent(),
             "x-request-id": context.id,
         }
         if remaining is not None:
@@ -345,6 +388,7 @@ class TransportClient:
         fault = faults.active("client.send", addr)
         if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
             conn.streams.pop(rid, None)
+            _fail_span(ERR_UNAVAILABLE)
             raise EngineError(
                 f"worker {addr} send failed: injected fault", ERR_UNAVAILABLE
             )
@@ -359,6 +403,7 @@ class TransportClient:
         except (ConnectionResetError, BrokenPipeError, OSError) as exc:
             conn.streams.pop(rid, None)
             conn.close()
+            _fail_span(ERR_UNAVAILABLE)
             raise EngineError(f"worker {addr} send failed: {exc}", ERR_UNAVAILABLE)
 
         # One long-lived watcher per stream injects a sentinel into the demux
@@ -393,11 +438,13 @@ class TransportClient:
                     except asyncio.TimeoutError:
                         cancel_sent = True
                         await self._send_cancel(conn, rid, True)
+                        _fail_span(ERR_TIMEOUT)
                         raise EngineError(
                             f"worker {addr} exceeded the request deadline",
                             ERR_TIMEOUT,
                         )
                 if msg is None:
+                    _fail_span(ERR_UNAVAILABLE)
                     raise EngineError(
                         f"worker {addr} connection dropped mid-stream",
                         ERR_UNAVAILABLE,
@@ -415,10 +462,17 @@ class TransportClient:
                     # stream (it emits the tokens generated so far)
                     continue
                 if t == "data":
+                    if not span.ended:
+                        # the span measures push → first response frame;
+                        # token streaming after that belongs to the engine
+                        span.add_event("first_frame")
+                        span.end()
                     yield msgpack.unpackb(msg["payload"], raw=False)
                 elif t == "end":
+                    span.end()
                     return
                 elif t == "err":
+                    _fail_span(msg.get("code", ERR_APP))
                     raise EngineError(
                         msg.get("error", "worker error"),
                         msg.get("code", ERR_APP),
@@ -426,6 +480,7 @@ class TransportClient:
         finally:
             stop_task.cancel()
             conn.streams.pop(rid, None)
+            _fail_span("closed_before_first_frame")
             if (context.is_stopped() or context.is_killed()) and not cancel_sent:
                 await self._send_cancel(conn, rid, context.is_killed())
 

@@ -1,0 +1,40 @@
+"""Distributed request tracing: timed spans over the existing
+``traceparent`` propagation, per-stage latency attribution, trace export.
+
+The span model lives in :mod:`.span`, the process-global collector (ring
+buffer + sampling + slow-request auto-dump) in :mod:`.collector`, the
+JSONL / in-memory / Prometheus sinks in :mod:`.export`, and the offline
+per-trace assembler (also a CLI: ``python -m dynamo_tpu.tracing``) in
+:mod:`.assemble`.
+
+Stage names instrumented across the serving path::
+
+    frontend.request      root span of one HTTP request
+    frontend.admission    admission-controller queue wait
+    frontend.tokenize     template render + tokenization
+    migration.attempt     one issue of the request to the cluster
+    migration.backoff     retry backoff sleep
+    router.select         KV-router score + select
+    transport.send        client push → first response frame
+    worker.ingress        worker-side root: request arrival → stream done
+    worker.queue          engine admission → first scheduled chunk
+    engine.prefill        first scheduled chunk → first token
+    engine.decode         first token → stream end
+"""
+
+from .collector import (
+    SpanCollector, configure, get_tracer, reset, trace_span,
+)
+from .export import InMemorySpanExporter, JsonlSpanExporter
+from .span import Span
+
+__all__ = [
+    "InMemorySpanExporter",
+    "JsonlSpanExporter",
+    "Span",
+    "SpanCollector",
+    "configure",
+    "get_tracer",
+    "reset",
+    "trace_span",
+]

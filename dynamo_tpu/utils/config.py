@@ -70,6 +70,17 @@ class RuntimeConfig:
     jsonl_logging: bool = False
     log_level: str = "INFO"
     num_io_threads: int = 8
+    # -- request tracing (dynamo_tpu.tracing) --
+    # head-sampling ratio by trace id in [0, 1]; 0 disables span export
+    # (stage_latency_seconds histograms are observed regardless)
+    trace_sample_ratio: float = 0.0
+    # root spans slower than this are exported even when unsampled
+    # (slow-request auto-dump); 0 disables
+    trace_slow_threshold_s: float = 0.0
+    # JSONL span export path for the offline assembler; "" disables
+    trace_export_path: str = ""
+    # in-process span ring buffer (serves the /debug/traces endpoint)
+    trace_buffer_size: int = 4096
 
     @staticmethod
     def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
@@ -107,6 +118,18 @@ class RuntimeConfig:
         cfg.jsonl_logging = env_flag(ENV_PREFIX + "JSONL_LOGGING", cfg.jsonl_logging)
         cfg.log_level = env_str(ENV_PREFIX + "LOG_LEVEL", cfg.log_level)
         cfg.num_io_threads = env_int(ENV_PREFIX + "IO_THREADS", cfg.num_io_threads)
+        cfg.trace_sample_ratio = env_float(
+            ENV_PREFIX + "TRACE_SAMPLE_RATIO", cfg.trace_sample_ratio
+        )
+        cfg.trace_slow_threshold_s = env_float(
+            ENV_PREFIX + "TRACE_SLOW_THRESHOLD_S", cfg.trace_slow_threshold_s
+        )
+        cfg.trace_export_path = env_str(
+            ENV_PREFIX + "TRACE_EXPORT_PATH", cfg.trace_export_path
+        )
+        cfg.trace_buffer_size = env_int(
+            ENV_PREFIX + "TRACE_BUFFER_SIZE", cfg.trace_buffer_size
+        )
         return cfg
 
     @property

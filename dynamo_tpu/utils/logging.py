@@ -42,7 +42,11 @@ class TraceContext:
         m = _TRACEPARENT_RE.match(traceparent.strip().lower())
         if not m:
             return None
-        _, trace_id, span_id, flags = m.groups()
+        version, trace_id, span_id, flags = m.groups()
+        # version ff is reserved-invalid by the W3C spec (§4.1); all-zero
+        # ids are likewise invalid
+        if version == "ff":
+            return None
         if trace_id == "0" * 32 or span_id == "0" * 16:
             return None
         return TraceContext(trace_id=trace_id, span_id=span_id, flags=flags)

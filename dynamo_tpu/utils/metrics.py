@@ -114,3 +114,15 @@ class _Bound:
         merged = dict(self._const)
         merged.update(extra)
         return self._metric.labels(**merged)
+
+    def remove(self, **extra: str) -> None:
+        """Drop one label-set's child series (e.g. a departed worker's
+        gauges) so stale values stop being scraped. No-op if the label set
+        was never observed."""
+        merged = dict(self._const)
+        merged.update(extra)
+        try:
+            values = [merged[n] for n in self._metric._labelnames]
+            self._metric.remove(*values)
+        except KeyError:
+            pass

@@ -1,0 +1,571 @@
+"""Distributed request tracing: span model, collector sinks, sampling,
+slow-dump, cross-stage parenting through migration, and the full
+frontend → router → worker assembly with a mid-stream crash."""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import tracing
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.transport import ERR_UNAVAILABLE, EngineError
+from dynamo_tpu.tracing import InMemorySpanExporter, SpanCollector
+from dynamo_tpu.tracing.assemble import (
+    assemble_trace, group_traces, load_spans, render_trace,
+)
+from dynamo_tpu.utils.logging import TraceContext
+from dynamo_tpu.utils.metrics import MetricsRegistry
+
+pytestmark = [pytest.mark.anyio, pytest.mark.tracing]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture
+def tracer():
+    """Isolated process-global collector, restored after the test."""
+    collector = tracing.reset()
+    yield collector
+    tracing.reset()
+
+
+# ------------------------- traceparent parsing ---------------------------
+
+
+def test_traceparent_round_trip():
+    tc = TraceContext.new()
+    parsed = TraceContext.parse(tc.traceparent())
+    assert parsed is not None
+    assert parsed.trace_id == tc.trace_id
+    assert parsed.span_id == tc.span_id
+    assert parsed.flags == tc.flags
+
+
+def test_traceparent_rejects_version_ff():
+    tc = TraceContext.new()
+    bad = f"ff-{tc.trace_id}-{tc.span_id}-01"
+    assert TraceContext.parse(bad) is None
+    # any other version value parses (spec: unknown versions are forward-
+    # compatible as long as the tail matches)
+    ok = f"01-{tc.trace_id}-{tc.span_id}-01"
+    assert TraceContext.parse(ok) is not None
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "not-a-traceparent",
+    "00-short-beef-01",
+    "00-" + "0" * 32 + "-" + "ab" * 8 + "-01",   # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+    "00-" + "gg" * 16 + "-" + "ab" * 8 + "-01",  # non-hex
+    "00_" + "ab" * 16 + "_" + "ab" * 8 + "_01",  # wrong separators
+    "00-" + "ab" * 16 + "-" + "ab" * 8,          # missing flags
+])
+def test_traceparent_rejects_malformed(bad):
+    assert TraceContext.parse(bad) is None
+
+
+# ------------------------- sampling determinism --------------------------
+
+
+def test_sampling_deterministic_across_collectors():
+    """Two collectors with the same salt make identical keep/drop decisions
+    for every trace id — the cluster-wide coordination-free property."""
+    a = SpanCollector(sample_ratio=0.5, sample_salt=42)
+    b = SpanCollector(sample_ratio=0.5, sample_salt=42)
+    ids = [f"{i:032x}" for i in range(1, 401)]
+    decisions = [a.sampled(t) for t in ids]
+    assert decisions == [b.sampled(t) for t in ids]
+    # the hash actually splits the population near the ratio
+    kept = sum(decisions)
+    assert 120 < kept < 280
+    # a different salt re-shuffles the decision boundary
+    c = SpanCollector(sample_ratio=0.5, sample_salt=43)
+    assert [c.sampled(t) for t in ids] != decisions
+
+
+def test_sampling_edges():
+    c = SpanCollector(sample_ratio=0.0)
+    assert not c.sampled("ab" * 16)
+    c.configure(sample_ratio=1.0)
+    assert c.sampled("ab" * 16)
+
+
+# --------------------------- collector sinks -----------------------------
+
+
+def test_metrics_observed_even_when_unsampled(tracer):
+    reg = MetricsRegistry(prefix="trc_m")
+    tracer.attach_metrics(reg)
+    tracer.configure(sample_ratio=0.0)
+    exp = InMemorySpanExporter()
+    tracer.add_exporter(exp)
+    span = tracer.start_span("frontend.tokenize")
+    span.end()
+    body = reg.render().decode()
+    assert 'trc_m_stage_latency_seconds_count{stage="frontend.tokenize"}' \
+        in body
+    # exporters stayed silent: not sampled, not slow
+    assert exp.spans == []
+
+
+def test_slow_request_auto_dump(tracer):
+    """An over-threshold *root* dumps its whole trace even at ratio 0."""
+    tracer.configure(sample_ratio=0.0, slow_threshold_s=1.0)
+    exp = InMemorySpanExporter()
+    tracer.add_exporter(exp)
+
+    now = time.monotonic()
+    ctx = Context()
+    # a fast trace exports nothing
+    fast = tracer.start_span("frontend.request", trace=ctx.trace, root=True)
+    fast.end()
+    assert exp.spans == []
+
+    # a slow trace flushes root + children still in the ring
+    ctx2 = Context()
+    tracer.record("engine.decode", ctx2,
+                  start_mono=now - 4.0, end_mono=now - 0.5)
+    root = tracer.start_span("frontend.request", trace=ctx2.trace, root=True)
+    root.start_mono = now - 5.0
+    root.end()
+    names = sorted(s.name for s in exp.spans)
+    assert names == ["engine.decode", "frontend.request"]
+    assert all(s.trace_id == ctx2.trace.trace_id for s in exp.spans)
+
+
+def test_record_derives_wall_anchor(tracer):
+    """record() back-dates start_unix by the monotonic elapsed, so spans
+    stamped in the past land at the right wall-clock position."""
+    start = time.monotonic() - 2.0
+    span = tracer.record("worker.queue", start_mono=start,
+                         end_mono=start + 0.5)
+    assert abs((time.time() - 2.0) - span.start_unix) < 0.1
+    assert span.duration_s == pytest.approx(0.5)
+
+
+def test_ring_buffer_bounded(tracer):
+    tracer.configure(buffer_size=8)
+    for i in range(32):
+        tracer.start_span(f"s{i}").end()
+    assert len(tracer.get_trace("nope")) == 0
+    assert len(tracer.trace_ids(limit=100)) == 8
+
+
+# --------------------- migration keeps one trace -------------------------
+
+
+class FlakyEngine(AsyncEngine):
+    """Streams 2 tokens then dies once; clean on the retry."""
+
+    def __init__(self):
+        self.calls = 0
+        self.contexts = []
+
+    async def generate(self, request, context):
+        self.calls += 1
+        self.contexts.append(context)
+        start = len(request["token_ids"])
+        n = int(request["max_tokens"])
+        for i in range(n):
+            yield {"token_ids": [100 + start + i],
+                   "finished": i == n - 1,
+                   "finish_reason": "length" if i == n - 1 else None,
+                   "num_prompt_tokens": start}
+            if self.calls == 1 and i == 1:
+                raise EngineError("boom", ERR_UNAVAILABLE)
+
+
+async def test_migration_attempts_share_one_trace(tracer):
+    """A fault-migrated request stays ONE trace: each retry is a sibling
+    migration.attempt child span under the request context, the failed one
+    carrying the error status, the backoff nap its own span."""
+    tracer.configure(sample_ratio=1.0)
+    exp = InMemorySpanExporter()
+    tracer.add_exporter(exp)
+
+    flaky = FlakyEngine()
+    mig = Migration(flaky, migration_limit=2, backoff_base_s=0.001)
+    ctx = Context()
+    out = [x async for x in mig.generate(
+        {"token_ids": [1, 2, 3], "max_tokens": 5}, ctx)]
+    assert out[-1]["finished"]
+
+    spans = exp.by_trace()[ctx.trace.trace_id]
+    attempts = [s for s in spans if s.name == "migration.attempt"]
+    backoffs = [s for s in spans if s.name == "migration.backoff"]
+    assert len(attempts) == 2 and len(backoffs) == 1
+    # both attempts parent under the request context's span id
+    assert {s.parent_span_id for s in attempts} == {ctx.trace.span_id}
+    assert attempts[0].status == "error"
+    assert attempts[0].status_detail == ERR_UNAVAILABLE
+    assert attempts[1].status == "ok"
+    # the attempt span's own id IS the attempt context's span id, so
+    # downstream spans (router/transport) parent under the right attempt
+    assert {s.span_id for s in attempts} == \
+        {c.trace.span_id for c in flaky.contexts}
+    # the failed attempt closed before the backoff nap started
+    assert attempts[0].end_mono <= backoffs[0].start_mono
+    # everything stayed in one trace
+    assert len(exp.by_trace()) == 1
+
+
+# --------------------------- offline assembly ----------------------------
+
+
+def test_assembler_joins_and_dedupes(tracer, tmp_path):
+    path_a = str(tmp_path / "front.jsonl")
+    path_b = str(tmp_path / "worker.jsonl")
+    tracer.configure(sample_ratio=1.0)
+    tracer.add_jsonl(path_a)
+
+    ctx = Context()
+    root = tracer.start_span("frontend.request", trace=ctx.trace, root=True)
+    child = tracer.start_span("frontend.tokenize", ctx)
+    child.end()
+    root.end()
+    # the "worker" file repeats the child (slow-dump double export shape)
+    with open(path_b, "w") as f:
+        f.write(json.dumps(child.to_dict()) + "\n")
+        f.write(json.dumps({**root.to_dict(),
+                            "span_id": "feedfacefeedface",
+                            "parent_span_id": root.span_id,
+                            "name": "worker.ingress"}) + "\n")
+
+    spans = load_spans([path_a, path_b])
+    assert len(spans) == 3  # duplicate child collapsed
+    traces = group_traces(spans)
+    assembled = assemble_trace(traces[ctx.trace.trace_id])
+    assert assembled["num_spans"] == 3
+    by_name = {s["name"]: s for s in assembled["spans"]}
+    assert by_name["frontend.request"]["depth"] == 0
+    assert by_name["frontend.tokenize"]["depth"] == 1
+    assert by_name["worker.ingress"]["depth"] == 1
+    assert "frontend.tokenize" in assembled["stages"]
+    text = render_trace(assembled)
+    assert "stage breakdown:" in text and "frontend.request" in text
+
+
+def test_assembler_cli(tracer, tmp_path, capsys):
+    from dynamo_tpu.tracing.assemble import main
+
+    path = str(tmp_path / "spans.jsonl")
+    tracer.configure(sample_ratio=1.0)
+    tracer.add_jsonl(path)
+    ctx = Context()
+    tracer.start_span("router.select", ctx).end()
+    tracer.start_span("frontend.request", trace=ctx.trace, root=True).end()
+
+    assert main([path]) == 0
+    out = capsys.readouterr().out
+    assert "router.select" in out and ctx.trace.trace_id in out
+    assert main([path, "--trace-id", "deadbeef"]) == 1
+    assert main([path, "--trace-id", ctx.trace.trace_id, "--json"]) == 0
+    assembled = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert assembled["trace_id"] == ctx.trace.trace_id
+
+
+# ------------------------ aggregator staleness ---------------------------
+
+
+def test_aggregator_expires_stale_workers():
+    from types import SimpleNamespace
+
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+
+    clock = {"t": 0.0}
+    metrics = MetricsRegistry(prefix="trc_agg")
+    runtime = SimpleNamespace(
+        metrics=metrics,
+        namespace=lambda *a, **k: SimpleNamespace(
+            component=lambda name: SimpleNamespace(
+                event_subject=lambda s: f"trc.{name}.{s}")),
+    )
+    agg = MetricsAggregator(runtime, "backend", stale_after_s=30.0,
+                            clock=lambda: clock["t"])
+    agg._on_stats({"worker_id": 1, "kv_usage": 0.2,
+                   "prefix_cache_hits": 10, "prefix_cache_queries": 20})
+    agg._on_stats({"worker_id": 2, "kv_usage": 0.8,
+                   "prefix_cache_hits": 0, "prefix_cache_queries": 20})
+    body = metrics.render().decode()
+    assert 'worker="1"' in body and 'worker="2"' in body
+    assert 'prefix_cache_hit_rate{component="backend"} 0.25' in body
+
+    # worker 2 goes silent past the threshold; worker 1 keeps publishing
+    clock["t"] = 31.0
+    agg._on_stats({"worker_id": 1, "kv_usage": 0.3,
+                   "prefix_cache_hits": 10, "prefix_cache_queries": 20})
+    assert "2" not in agg.worker_stats and "2" not in agg._last_seen
+    body = metrics.render().decode()
+    assert 'worker="2"' not in body          # gauge label set cleared
+    assert 'worker="1"' in body
+    # hit rate recomputed over the survivors only
+    assert 'prefix_cache_hit_rate{component="backend"} 0.5' in body
+
+
+# -------------------------- recorder wall anchor -------------------------
+
+
+async def test_recorder_carries_wall_anchor_and_trace_id(tmp_path):
+    from dynamo_tpu.llm.recorder import Recorder
+
+    path = str(tmp_path / "rec.jsonl")
+    rec = Recorder(path=path)
+
+    async def stream():
+        yield {"token": 0}
+
+    before = time.time()
+    async for _ in rec.record_stream("r1", stream(), trace_id="ab" * 16):
+        pass
+    row = json.loads(open(path).read().splitlines()[0])
+    assert row["trace_id"] == "ab" * 16
+    assert before - 1.0 <= row["t_start_unix"] <= time.time()
+    # trace_id stays optional: absent from the row when not provided
+    rec2 = Recorder(path=path)
+    async for _ in rec2.record_stream("r2", stream()):
+        pass
+    row2 = json.loads(open(path).read().splitlines()[1])
+    assert "trace_id" not in row2 and "t_start_unix" in row2
+
+
+# -------------------- e2e: crash, migrate, assemble ----------------------
+
+
+@pytest.fixture
+async def cluster(tmp_path):
+    """store + 2 MockEngine workers on real ingress + KV-routed HTTP
+    frontend with admission control, all sharing one process tracer."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.frontend.service import (
+        HttpService, ModelEntry, ModelManager,
+    )
+    from dynamo_tpu.llm.discovery import ModelDeploymentCard
+    from dynamo_tpu.llm.entrypoint import build_routed_pipeline, make_kv_sink
+    from dynamo_tpu.mocker import MockEngine, MockerConfig
+    from dynamo_tpu.router.kv_router import KvRouterConfig
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    from test_llm_pipeline import byte_tokenizer
+
+    tracing.reset()
+    store = StoreServer(host="127.0.0.1", port=0)
+    await store.start()
+    cfg = RuntimeConfig(store_addr=f"127.0.0.1:{store.port}")
+
+    engines, serveds, runtimes = [], [], []
+    for _ in range(2):
+        rt = await DistributedRuntime.from_settings(cfg)
+        engine = MockEngine(
+            EngineConfig(block_size=4, num_blocks=64, max_model_len=256,
+                         max_num_batched_tokens=256, max_num_seqs=8),
+            MockerConfig(vocab_size=512, speedup_ratio=10.0),
+        )
+        await engine.start()
+        ep = rt.namespace("trc").component("backend").endpoint("generate")
+        serveds.append(await ep.serve_endpoint(engine))
+        engines.append(engine)
+        runtimes.append(rt)
+
+    front_rt = await DistributedRuntime.from_settings(cfg)
+    client = await (front_rt.namespace("trc").component("backend")
+                    .endpoint("generate").client())
+    await client.wait_for_instances(2, timeout_s=10.0)
+
+    tk = byte_tokenizer()
+    card = ModelDeploymentCard(
+        name="tiny-chat", tokenizer_json=tk.to_json_str(),
+        context_length=256, kv_block_size=4, migration_limit=2,
+    )
+    sink, router = await make_kv_sink(
+        card, client, use_events=False, seed=0,
+        config=KvRouterConfig(replica_sync=False, snapshot_threshold=0),
+    )
+    manager = ModelManager()
+    manager.register(ModelEntry(
+        name="tiny-chat",
+        engine=build_routed_pipeline(card, client, sink=sink),
+    ))
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          metrics=MetricsRegistry(prefix="trc_e2e"),
+                          max_concurrent_requests=8)
+    await service.start()
+
+    # export everything: configured AFTER the runtimes so from_settings's
+    # defaults (ratio 0) don't clobber the test knobs
+    exporter = InMemorySpanExporter()
+    jsonl_path = str(tmp_path / "spans.jsonl")
+    tracer = tracing.get_tracer()
+    tracer.configure(sample_ratio=1.0)
+    tracer.add_exporter(exporter)
+    tracer.add_jsonl(jsonl_path)
+
+    yield {"service": service, "exporter": exporter, "jsonl": jsonl_path,
+           "engines": engines, "tracer": tracer}
+
+    await service.stop()
+    await router.stop()
+    await client.stop()
+    for served in serveds:
+        await served.stop()
+    for engine in engines:
+        await engine.stop()
+    await front_rt.shutdown()
+    for rt in runtimes:
+        await rt.shutdown()
+    await store.stop()
+    tracing.reset()
+
+
+# every stage the instrumented path must produce for a migrated request
+E2E_STAGES = {
+    "frontend.request", "frontend.admission", "frontend.tokenize",
+    "migration.attempt", "migration.backoff", "router.select",
+    "transport.send", "worker.ingress", "worker.queue",
+    "engine.prefill", "engine.decode",
+}
+# pairwise-disjoint leaf windows: their summed time can never exceed the
+# observed end-to-end latency
+E2E_LEAVES = {
+    "frontend.admission", "frontend.tokenize", "router.select",
+    "worker.queue", "engine.prefill", "engine.decode", "migration.backoff",
+}
+
+
+@pytest.mark.e2e
+async def test_e2e_trace_with_midstream_crash(cluster, tmp_path):
+    """One request, one injected worker crash, one migration — and ONE
+    assembled trace covering admission through decode on both workers."""
+    from dynamo_tpu.runtime import faults
+
+    plan = faults.FaultPlan(seed=0)
+    plan.truncate_stream("worker.stream", match=None, after=3, times=1)
+    faults.install(plan)
+    t0 = time.monotonic()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{cluster['service'].port}"
+                "/v1/chat/completions",
+                json={"model": "tiny-chat", "max_tokens": 8,
+                      "messages": [{"role": "user", "content": "hello"}]},
+                timeout=aiohttp.ClientTimeout(total=60),
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+    finally:
+        faults.clear()
+    elapsed = time.monotonic() - t0
+    assert plan.fired("worker.stream") == 1
+    assert body["usage"]["completion_tokens"] == 8
+
+    # worker-side engine spans and late parent closes land during stream
+    # teardown — poll until the tree is complete (every stage present, both
+    # attempts/ingresses exported, every parent resolvable)
+    exporter = cluster["exporter"]
+
+    def _complete() -> bool:
+        snapshot = list(exporter.spans)
+        names = [s.name for s in snapshot]
+        if not (E2E_STAGES <= set(names)):
+            return False
+        if names.count("migration.attempt") < 2 \
+                or names.count("worker.ingress") < 2:
+            return False
+        ids = {s.span_id for s in snapshot}
+        return all(s.parent_span_id in ids for s in snapshot
+                   if s.parent_span_id is not None)
+
+    for _ in range(200):
+        if _complete():
+            break
+        await asyncio.sleep(0.02)
+    traces = exporter.by_trace()
+    assert len(traces) == 1, f"expected ONE trace, got {list(traces)}"
+    trace_id, spans = next(iter(traces.items()))
+    names = {s.name for s in spans}
+    assert E2E_STAGES <= names, f"missing stages: {E2E_STAGES - names}"
+
+    by_id = {s.span_id: s for s in spans}
+    roots = [s for s in spans if s.name == "frontend.request"]
+    assert len(roots) == 1 and roots[0].parent_span_id is None
+    # every non-root span links into the tree (worker roots hang off the
+    # wire transport span, which is in the same export set)
+    for s in spans:
+        if s is roots[0]:
+            continue
+        assert s.parent_span_id in by_id, \
+            f"{s.name} orphaned (parent {s.parent_span_id})"
+
+    # the crashed attempt is visible: one errored migration.attempt with a
+    # retry sibling, and the injected crash marked on the worker root
+    attempts = sorted((s for s in spans if s.name == "migration.attempt"),
+                      key=lambda s: s.start_mono)
+    assert len(attempts) == 2
+    assert attempts[0].status == "error" and attempts[1].status == "ok"
+    ingresses = [s for s in spans if s.name == "worker.ingress"]
+    assert len(ingresses) == 2
+    assert sorted(s.status for s in ingresses) == ["error", "ok"]
+
+    # disjoint leaf windows sum to no more than the observed e2e latency
+    leaf_total = sum((s.duration_s or 0.0) for s in spans
+                     if s.name in E2E_LEAVES)
+    assert leaf_total <= elapsed + 0.01, (leaf_total, elapsed)
+
+    # per-stage latency histograms reached the frontend Prometheus scrape
+    scrape = cluster["service"].metrics.render().decode()
+    assert 'trc_e2e_stage_latency_seconds_count{stage="frontend.request"}' \
+        in scrape
+    assert 'stage="engine.decode"' in scrape
+
+    # the offline assembler reproduces the same single-trace picture
+    assembled = assemble_trace(
+        group_traces(load_spans([cluster["jsonl"]]))[trace_id]
+    )
+    assert assembled["num_spans"] == len(spans)
+    assert set(assembled["stages"]) == names
+    assert "migration.attempt" in render_trace(assembled)
+
+
+@pytest.mark.e2e
+async def test_debug_trace_endpoint(cluster):
+    """The system server serves assembled traces out of the live ring."""
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"http://127.0.0.1:{cluster['service'].port}/v1/completions",
+            json={"model": "tiny-chat", "prompt": "abc", "max_tokens": 4},
+            timeout=aiohttp.ClientTimeout(total=60),
+        ) as r:
+            assert r.status == 200
+
+    server = SystemServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/debug/traces") as r:
+                assert r.status == 200
+                listing = await r.json()
+            assert listing["count"] >= 1
+            tid = listing["trace_ids"][0]
+            async with s.get(f"{base}/debug/traces/{tid}") as r:
+                assert r.status == 200
+                assembled = await r.json()
+            assert assembled["trace_id"] == tid
+            assert assembled["num_spans"] >= 1
+            async with s.get(f"{base}/debug/traces/{'0' * 32}") as r:
+                assert r.status == 404
+    finally:
+        await server.stop()
