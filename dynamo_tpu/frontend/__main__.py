@@ -205,8 +205,8 @@ async def run_frontend(args: argparse.Namespace) -> None:
                     await runtime.store.publish(
                         subject, msgpack.packb(win)
                     )
-                except Exception:
-                    log.exception("frontend stats publish failed")
+                except Exception as exc:
+                    log.warning("frontend stats publish failed: %s", exc)
 
         stats_task = asyncio.create_task(_publish_stats())
 

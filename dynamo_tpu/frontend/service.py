@@ -369,7 +369,10 @@ class HttpService:
     def _engine_status(e: EngineError) -> int:
         if e.code == ERR_TIMEOUT:
             return 504
-        return 503 if e.code in ("unavailable", "overloaded") else 500
+        # draining surfaces only when migration exhausted its retries with
+        # every instance draining — a transient 503, like unavailability
+        return 503 if e.code in ("unavailable", "overloaded",
+                                 "draining") else 500
 
     # --------------------------- routes --------------------------------
 

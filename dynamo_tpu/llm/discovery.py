@@ -141,11 +141,19 @@ class ModelWatcher:
         self.on_add = on_add
         self.on_remove = on_remove
         self._task: Optional[asyncio.Task] = None
+        self._stream = None
         # model name → set of instance keys serving it
         self._instances: Dict[str, set] = {}
 
     async def start(self) -> None:
-        snapshot, stream = await self.runtime.store.watch_prefix(MODEL_ROOT)
+        # resilient watch: survives store restarts by catch-up or snapshot
+        # reconcile; during the outage we keep serving the models we know
+        # about (stale-while-revalidate) rather than tearing pipelines down
+        snapshot, stream = await self.runtime.store.watch_prefix_resilient(
+            MODEL_ROOT,
+            grace_s=self.runtime.config.store_reconcile_grace_s,
+        )
+        self._stream = stream
         for key, value in snapshot:
             await self._handle_put(key, value)
         self._task = asyncio.create_task(self._loop(stream))
@@ -153,6 +161,9 @@ class ModelWatcher:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        if self._stream is not None:
+            await self._stream.cancel()
+            self._stream = None
 
     async def _loop(self, stream) -> None:
         while True:
@@ -160,25 +171,7 @@ class ModelWatcher:
             if event is None:
                 return
             if event["event"] == "dropped":
-                log.warning("model watch dropped — resubscribing")
-                await stream.cancel()
-                while True:  # outlast a store reconnect window
-                    try:
-                        snapshot, stream = (
-                            await self.runtime.store.watch_prefix(MODEL_ROOT)
-                        )
-                        break
-                    except Exception:
-                        log.exception("model rewatch failed — retrying")
-                        await asyncio.sleep(0.5)
-                live_keys = {k for k, _ in snapshot}
-                for name, keys in list(self._instances.items()):
-                    for k in list(keys):
-                        if k not in live_keys:
-                            await self._handle_delete(k)
-                for key, value in snapshot:
-                    await self._handle_put(key, value)
-                continue
+                continue  # the resilient stream already resynced
             try:
                 if event["event"] == "put":
                     await self._handle_put(event["key"], event["value"])

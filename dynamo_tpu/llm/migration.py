@@ -20,14 +20,16 @@ from typing import Any, AsyncIterator, Optional
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..runtime.transport import (
-    EngineError, ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE,
+    EngineError, ERR_DRAINING, ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE,
 )
 from ..tracing import get_tracer, trace_span
 from ..utils.logging import get_logger
 
 log = get_logger("migration")
 
-RETRYABLE = (ERR_UNAVAILABLE, ERR_OVERLOADED)
+# ``draining`` is a planned divert (the router routes the retry elsewhere),
+# not a failure — retryable like unavailability but never breaker-tripping
+RETRYABLE = (ERR_UNAVAILABLE, ERR_OVERLOADED, ERR_DRAINING)
 
 
 class Migration(AsyncEngine):

@@ -20,7 +20,9 @@ from ..runtime.circuit import CircuitBreakerRegistry
 from ..runtime.component import Client, Component
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
-from ..runtime.transport import EngineError, ERR_OVERLOADED, ERR_UNAVAILABLE
+from ..runtime.transport import (
+    EngineError, ERR_DRAINING, ERR_OVERLOADED, ERR_UNAVAILABLE,
+)
 from ..tracing import trace_span
 from ..utils.logging import get_logger
 from ..tokens import compute_block_hashes_for_seq
@@ -86,7 +88,11 @@ class KvRouter:
         self.num_peer_events = 0
         self._events_at_snapshot = 0
         self._snapshot_task: Optional[asyncio.Task] = None
+        # workers that answered ``draining``: divert-elsewhere until their
+        # instance key is deleted (drain completed) or re-put (re-advertised)
+        self.draining: Set[int] = set()
         client.on_instance_removed.append(self._on_worker_removed)
+        client.on_instance_added.append(self._on_worker_added)
 
     # -- lifecycle --
 
@@ -146,14 +152,26 @@ class KvRouter:
             self.client.on_instance_removed.remove(self._on_worker_removed)
         except ValueError:
             pass
+        try:
+            self.client.on_instance_added.remove(self._on_worker_added)
+        except ValueError:
+            pass
 
     async def _resubscribe(self, subject: str):
         store = self.client.runtime.store
+        attempt = 0
         while True:
             try:
                 return await store.subscribe(subject)
-            except Exception:
-                log.exception("resubscribe %s failed — retrying", subject)
+            except Exception as exc:
+                # traceback once; during a store outage this retries every
+                # 0.5s per topic and repeating it would drown the log
+                if attempt == 0:
+                    log.exception("resubscribe %s failed — retrying", subject)
+                else:
+                    log.warning("resubscribe %s failed (attempt %d): %s",
+                                subject, attempt + 1, exc)
+                attempt += 1
                 await asyncio.sleep(0.5)
 
     async def _event_loop(self, stream) -> None:
@@ -338,6 +356,17 @@ class KvRouter:
         self.loads.remove_worker(worker_id)
         self.worker_stats.pop(worker_id, None)
         self.breakers.remove(worker_id)
+        self.draining.discard(worker_id)
+
+    def _on_worker_added(self, worker_id: int) -> None:
+        # a re-put of the instance key (health recovery re-advertisement)
+        # means the worker takes traffic again
+        self.draining.discard(worker_id)
+
+    def mark_draining(self, worker_id: int) -> None:
+        """Divert new work away from a worker that rejected with ``draining``
+        (covers the race before its instance-key delete reaches our watch)."""
+        self.draining.add(worker_id)
 
     # -- routing (ref: kv_router.rs:291 find_best_match) --
 
@@ -366,6 +395,16 @@ class KvRouter:
                 ERR_UNAVAILABLE,
             )
         workers = admitted
+        # drain filter: a worker that answered ``draining`` takes no new
+        # traffic; unlike a breaker this clears the moment its key is
+        # deleted (drain done) or re-put (re-advertised)
+        if self.draining:
+            active = [w for w in workers if w not in self.draining]
+            if not active:
+                raise EngineError(
+                    f"all {len(workers)} workers draining", ERR_UNAVAILABLE
+                )
+            workers = active
         # busy-threshold rejection (ref: push_router.rs:58-63): drop workers
         # whose published KV usage exceeds the threshold; if every worker is
         # saturated, reject so the frontend returns 503 instead of queueing
@@ -462,8 +501,11 @@ class KvPushRouter(AsyncEngine):
             # only transport-level unavailability feeds the breaker;
             # overload/timeouts are load signals, not worker death, and
             # tripping on them would shrink capacity exactly when it is
-            # most needed
-            if e.code == ERR_UNAVAILABLE:
+            # most needed. A draining rejection is a planned divert: mark
+            # the worker so retries route elsewhere, but never punish it
+            if e.code == ERR_DRAINING:
+                self.router.mark_draining(sel.worker_id)
+            elif e.code == ERR_UNAVAILABLE:
                 healthy = False
                 self.router.breakers.record_failure(sel.worker_id)
             raise

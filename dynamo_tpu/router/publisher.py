@@ -37,6 +37,7 @@ class KvEventPublisher:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self.events_published = 0
+        self._pub_failures = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -67,8 +68,16 @@ class KvEventPublisher:
                         msgpack.packb(payload, use_bin_type=True),
                     )
                     self.events_published += 1
-            except Exception:
-                log.exception("kv event publish failed")
+                self._pub_failures = 0
+            except Exception as exc:
+                # traceback once per failure streak — a store outage makes
+                # every batch fail and repeating it floods the worker log
+                if self._pub_failures == 0:
+                    log.exception("kv event publish failed")
+                else:
+                    log.warning("kv event publish still failing (%d in a "
+                                "row): %s", self._pub_failures + 1, exc)
+                self._pub_failures += 1
 
     def _coalesce(self, events: List[dict]) -> List[dict]:
         """Merge runs of same-kind events into single wire messages (the
@@ -134,12 +143,19 @@ class WorkerMetricsPublisher:
 
     async def _pump(self) -> None:
         store = self.component.runtime.store
+        failures = 0
         while True:
             try:
                 await store.publish(
                     self.subject + str(self.worker_id),
                     msgpack.packb(self.snapshot(), use_bin_type=True),
                 )
-            except Exception:
-                log.exception("load metrics publish failed")
+                failures = 0
+            except Exception as exc:
+                if failures == 0:
+                    log.exception("load metrics publish failed")
+                else:
+                    log.warning("load metrics publish still failing (%d in "
+                                "a row): %s", failures + 1, exc)
+                failures += 1
             await asyncio.sleep(self.interval_s)

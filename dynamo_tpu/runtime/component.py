@@ -83,7 +83,10 @@ class DistributedRuntime:
     ) -> "DistributedRuntime":
         config = config or RuntimeConfig.from_settings()
         store = await StoreClient.connect(
-            config.store_addr, lease_ttl_s=config.lease_ttl_s
+            config.store_addr, lease_ttl_s=config.lease_ttl_s,
+            recover_timeout_s=config.store_recover_timeout_s,
+            reconnect_base_s=config.store_reconnect_base_s,
+            reconnect_cap_s=config.store_reconnect_cap_s,
         )
         tracer = tracing.get_tracer()
         tracer.configure(
@@ -215,7 +218,7 @@ class Endpoint:
                 lambda: {"healthy": not server.draining,
                          "inflight": server.num_inflight},
             )
-        return ServedEndpoint(self, server, instance)
+        return ServedEndpoint(self, server, instance, record=record)
 
     async def client(self) -> "Client":
         client = Client(self)
@@ -224,31 +227,83 @@ class Endpoint:
 
 
 class ServedEndpoint:
-    def __init__(self, endpoint: Endpoint, server: IngressServer, instance: Instance):
+    def __init__(
+        self, endpoint: Endpoint, server: IngressServer, instance: Instance,
+        record: Optional[dict] = None,
+    ):
         self.endpoint = endpoint
         self.server = server
         self.instance = instance
+        # kept so withdraw/readvertise can re-put the exact same record
+        self._record = record
 
-    async def drain_and_stop(self) -> None:
-        """Graceful shutdown: deregister, stop accepting, drain in-flight."""
+    async def drain_and_stop(
+        self, deadline_s: Optional[float] = None, stop_grace_s: float = 2.0,
+    ) -> None:
+        """Graceful shutdown: deregister (no new routing), reject late
+        arrivals as ``draining``, finish in-flight within ``deadline_s`` —
+        stragglers get their streams stopped so clients migrate — then stop.
+        """
         self.server.draining = True
         await self._deregister()
-        await self.server.join()
+        drained = await self.server.drain(deadline_s, stop_grace_s=stop_grace_s)
+        if not drained:
+            log.warning(
+                "%s: %d streams still in flight after drain — stopping hard",
+                self.endpoint.path, self.server.num_inflight,
+            )
         await self.server.stop()
 
     async def stop(self) -> None:
         await self._deregister()
         await self.server.stop()
 
-    async def _deregister(self) -> None:
+    async def withdraw(self) -> None:
+        """Pull the instance key so the cluster stops routing here, without
+        stopping the server (health-probe failure path)."""
         runtime = self.endpoint.runtime
-        await runtime.store.delete(self.instance.key)
+        try:
+            await runtime.store.delete(self.instance.key)
+        except Exception as exc:
+            log.warning("withdraw of %s failed (%s) — store down? the lease "
+                        "expiring will deregister us anyway",
+                        self.instance.key, exc)
+
+    async def readvertise(self) -> None:
+        """Re-put the instance key after health recovery so routing resumes."""
+        if self.server.draining:
+            return  # a recovered-but-draining worker must stay withdrawn
+        runtime = self.endpoint.runtime
+        record = self._record or {
+            "instance_id": self.instance.instance_id,
+            "addr": self.instance.addr,
+            "transport": "tcp",
+            "metadata": {},
+        }
+        await runtime.store.put(
+            self.instance.key,
+            msgpack.packb(record, use_bin_type=True),
+            lease=runtime.primary_lease,
+        )
+        log.info("re-advertised %s after recovery", self.instance.key)
+
+    async def _deregister(self) -> None:
+        # a drain must complete even while the store is unreachable: every
+        # store op here is best-effort (the lease dying cleans up for us)
+        runtime = self.endpoint.runtime
+        try:
+            await runtime.store.delete(self.instance.key)
+        except Exception as exc:
+            log.warning("deregister of %s failed: %s", self.instance.key, exc)
         path = self.endpoint.path
         if runtime.system_server is not None:
             runtime.system_server.unregister_probe(path)
         for ep_path, key in list(runtime.registered_models):
             if ep_path == path:
-                await runtime.store.delete(key)
+                try:
+                    await runtime.store.delete(key)
+                except Exception as exc:
+                    log.warning("deregister of %s failed: %s", key, exc)
                 runtime.registered_models.remove((ep_path, key))
 
 
@@ -265,14 +320,20 @@ class Client:
         self.busy_fn: Optional[Callable[[int], bool]] = None
         self._rr = 0
         self._watch_task: Optional[asyncio.Task] = None
+        self._watch_stream = None
         self._instances_changed = asyncio.Event()
         self.on_instance_removed: List[Callable[[int], None]] = []
         self.on_instance_added: List[Callable[[int], None]] = []
 
     async def start(self) -> None:
-        snapshot, stream = await self.runtime.store.watch_prefix(
-            self.endpoint.instance_prefix
+        # resilient watch: across store outages the stream resyncs itself
+        # (revision catch-up or snapshot reconcile) while we keep routing to
+        # the last-known instance table (stale-while-revalidate)
+        snapshot, stream = await self.runtime.store.watch_prefix_resilient(
+            self.endpoint.instance_prefix,
+            grace_s=self.runtime.config.store_reconcile_grace_s,
         )
+        self._watch_stream = stream
         for key, value in snapshot:
             self._apply("put", key, value)
         self._watch_task = asyncio.create_task(self._watch_loop(stream))
@@ -280,6 +341,9 @@ class Client:
     async def stop(self) -> None:
         if self._watch_task:
             self._watch_task.cancel()
+        if self._watch_stream is not None:
+            await self._watch_stream.cancel()
+            self._watch_stream = None
 
     def _apply(self, event: str, key: str, value: Optional[bytes]) -> None:
         instance_id = int(key.rsplit("/", 1)[1])
@@ -305,35 +369,10 @@ class Client:
         while True:
             event = await stream.next()
             if event is None:
-                return  # connection lost; lease loss shuts the runtime down
+                return  # client closed for good; lease loss shuts us down
             if event["event"] == "dropped":
-                # store shed this watch under backpressure — resubscribe with
-                # a fresh snapshot to resynchronise the instance table
-                log.warning("instance watch dropped — resubscribing")
-                await stream.cancel()
-                stream = await self._resubscribe()
-                continue
+                continue  # the resilient stream resyncs; nothing to do here
             self._apply(event["event"], event["key"], event.get("value"))
-
-    async def _resubscribe(self):
-        """Re-watch with retry; reconciles the instance table against the
-        fresh snapshot so no add/remove is lost across the gap."""
-        while True:
-            try:
-                snapshot, stream = await self.runtime.store.watch_prefix(
-                    self.endpoint.instance_prefix
-                )
-            except Exception:
-                log.exception("instance watch resubscribe failed — retrying")
-                await asyncio.sleep(0.5)
-                continue
-            live = {key: value for key, value in snapshot}
-            for _instance_id, inst in list(self.instances.items()):
-                if inst.key not in live:
-                    self._apply("delete", inst.key, None)
-            for key, value in live.items():
-                self._apply("put", key, value)
-            return stream
 
     def instance_ids(self) -> List[int]:
         return sorted(self.instances.keys())

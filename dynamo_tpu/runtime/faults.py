@@ -17,6 +17,8 @@ site                        key
 ``worker.admit``            request id arriving at the ingress server
 ``worker.stream``           request id, checked before each data frame
 ``store.call``              store op name (``put``, ``publish``, …)
+``store.connect``           store ``host:port`` being (re)dialled
+``store.watch``             watched key prefix at (re)subscribe time
 ==========================  =============================================
 
 Kinds and how sites interpret them:

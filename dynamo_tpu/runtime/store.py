@@ -33,7 +33,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import itertools
+import random
 import time
+import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
@@ -48,6 +51,7 @@ DEFAULT_PORT = 3280
 _MAX_FRAME = 256 * 1024 * 1024
 _MAX_SUB_BUFFER = 8 * 1024 * 1024   # slow-subscriber drop threshold
 _MAX_ORPHAN_EVENTS = 256            # per unclaimed watch id
+_MAX_EVENT_HISTORY = 4096           # retained events for watch rev catch-up
 
 
 # ------------------------------- framing ---------------------------------
@@ -145,6 +149,12 @@ class StoreServer:
         self._queues: Dict[str, "_WorkQueue"] = {}
         self._locks: Dict[str, Tuple[int, int]] = {}  # name -> (lease_id, watch count)
         self._revision = 0
+        # identifies this server process: a re-watching client presents the
+        # incarnation it was watching; a mismatch (store restarted) forces a
+        # full snapshot resync instead of a bogus revision catch-up
+        self.incarnation = uuid.uuid4().hex
+        # recent (rev, event, key, value) for revision catch-up on re-watch
+        self._history: deque = deque(maxlen=_MAX_EVENT_HISTORY)
         # time-seeded so a restarted store never re-issues watch/lease ids a
         # client still holds from the previous incarnation (a stale
         # WatchStream.cancel would otherwise unwatch a stranger's fresh id)
@@ -190,50 +200,99 @@ class StoreServer:
 
         if not os.path.exists(self.persist_path):
             return
+        # The snapshot is a stream of msgpack frames: a header record, one
+        # record per kv pair / queue, and a trailing {"eof": True}. A crash
+        # mid-write leaves a truncated or corrupt trailing frame — restore
+        # keeps everything up to the last good record instead of failing
+        # startup. (The legacy single-blob format is still readable.)
+        records: List[dict] = []
+        clean = False
         try:
             with open(self.persist_path, "rb") as f:
-                snap = msgpack.unpackb(f.read(), raw=False)
-            # build into locals and assign atomically: a corrupt section
-            # must yield EMPTY state, not a half-restored one that the next
-            # persist would overwrite the good snapshot with
-            revision = int(snap.get("revision", 0))
-            kv = {
-                key: _KvEntry(value, 0, revision, revision)
-                for key, value in snap.get("kv", [])
-            }
-            queues: Dict[str, _WorkQueue] = {}
-            for name, items in snap.get("queues", {}).items():
-                q = _WorkQueue()
-                q.items.extend(bytes(i) for i in items)
-                queues[name] = q
+                unpacker = msgpack.Unpacker(f, raw=False)
+                try:
+                    for rec in unpacker:
+                        if not isinstance(rec, dict):
+                            log.warning("store snapshot: non-dict frame — "
+                                        "stopping at last good record")
+                            break
+                        if rec.get("eof"):
+                            clean = True
+                            break
+                        records.append(rec)
+                except Exception as exc:
+                    log.warning(
+                        "store snapshot truncated/corrupt after %d records "
+                        "(%s) — continuing from last good record",
+                        len(records), exc,
+                    )
+        except Exception:
+            log.exception("store restore failed — starting empty")
+            return
+        if not records:
+            return
+        try:
+            first = records[0]
+            if "header" in first:
+                revision = int(first["header"].get("revision", 0))
+                kv: Dict[str, _KvEntry] = {}
+                queues: Dict[str, _WorkQueue] = {}
+                for rec in records[1:]:
+                    if "kv" in rec:
+                        key, value = rec["kv"]
+                        kv[key] = _KvEntry(value, 0, revision, revision)
+                    elif "q" in rec:
+                        name, items = rec["q"]
+                        q = _WorkQueue()
+                        q.items.extend(bytes(i) for i in items)
+                        queues[name] = q
+            else:
+                # legacy format: one blob {revision, kv, queues}
+                revision = int(first.get("revision", 0))
+                kv = {
+                    key: _KvEntry(value, 0, revision, revision)
+                    for key, value in first.get("kv", [])
+                }
+                queues = {}
+                for name, items in first.get("queues", {}).items():
+                    q = _WorkQueue()
+                    q.items.extend(bytes(i) for i in items)
+                    queues[name] = q
+                clean = True
             self._revision = revision
             self._kv = kv
             self._queues = queues
             log.info(
-                "restored %d keys, %d queues at revision %d from %s",
+                "restored %d keys, %d queues at revision %d from %s%s",
                 len(self._kv), len(self._queues), self._revision,
-                self.persist_path,
+                self.persist_path, "" if clean else " (truncated tail)",
             )
         except Exception:
             log.exception("store restore failed — starting empty")
+            self._revision = 0
+            self._kv = {}
+            self._queues = {}
 
     def _persist(self) -> None:
         import os
         import tempfile
 
         try:
-            snap = msgpack.packb({
-                "revision": self._revision,
-                # leased keys are liveness claims — never persisted
-                "kv": [[k, e.value] for k, e in sorted(self._kv.items())
-                       if e.lease_id == 0],
-                "queues": {name: q.items
-                           for name, q in self._queues.items() if q.items},
-            })
+            packer = msgpack.Packer(use_bin_type=True)
             d = os.path.dirname(os.path.abspath(self.persist_path))
             fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
             with os.fdopen(fd, "wb") as f:
-                f.write(snap)
+                f.write(packer.pack(
+                    {"header": {"revision": self._revision, "format": 2}}
+                ))
+                # leased keys are liveness claims — never persisted
+                for k, e in sorted(self._kv.items()):
+                    if e.lease_id == 0:
+                        f.write(packer.pack({"kv": [k, e.value]}))
+                for name, q in self._queues.items():
+                    if q.items:
+                        f.write(packer.pack({"q": [name, q.items]}))
+                f.write(packer.pack({"eof": True}))
             os.replace(tmp, self.persist_path)
             self._dirty = False
         except Exception:
@@ -313,6 +372,7 @@ class StoreServer:
             return False
 
     def _notify(self, event: str, key: str, value: Optional[bytes], rev: int) -> None:
+        self._history.append((rev, event, key, value))
         for watch in list(self._watches.values()):
             if key.startswith(watch.prefix):
                 self._push_event(
@@ -460,6 +520,14 @@ class StoreServer:
                 lease = self._leases.get(msg["lease"])
                 if lease is None:
                     return {"seq": seq, "ok": False, "error": "lease_expired"}
+                if lease.deadline < time.monotonic():
+                    # already past the deadline — the expire loop just hasn't
+                    # ticked yet. A late keepalive must NOT resurrect the
+                    # lease (watchers may already be reacting to the expiry);
+                    # revoke now so keepalive-vs-expiry ordering is settled
+                    # here, atomically, not by loop-tick luck.
+                    self._revoke(lease.lease_id)
+                    return {"seq": seq, "ok": False, "error": "lease_expired"}
                 lease.deadline = time.monotonic() + lease.ttl_s
                 return {"seq": seq, "ok": True, "ttl": lease.ttl_s}
             if op == "lease_revoke":
@@ -467,13 +535,36 @@ class StoreServer:
                 return {"seq": seq, "ok": True}
             if op == "watch":
                 watch_id = next(self._ids)
-                self._watches[watch_id] = _Watch(watch_id, msg["prefix"], writer)
+                prefix = msg["prefix"]
+                self._watches[watch_id] = _Watch(watch_id, prefix, writer)
                 conn_watches.append(watch_id)
+                # revision catch-up: a re-watching client that presents the
+                # revision it had seen (against the SAME server incarnation)
+                # gets exactly the events it missed instead of a snapshot —
+                # no reconcile diff needed on its side
+                since = msg.get("since_rev")
+                if (since is not None
+                        and msg.get("incarnation") == self.incarnation
+                        and self._covers(int(since))):
+                    events = [
+                        {"event": ev, "key": k, "value": v, "rev": rev}
+                        for rev, ev, k, v in self._history
+                        if rev > int(since) and k.startswith(prefix)
+                    ]
+                    return {
+                        "seq": seq,
+                        "ok": True,
+                        "watch_id": watch_id,
+                        "caught_up": True,
+                        "events": events,
+                        "rev": self._revision,
+                        "incarnation": self.incarnation,
+                    }
                 # current state snapshot so the watcher can't miss anything
                 kvs = [
                     [k, e.value, e.lease_id, e.mod_rev]
                     for k, e in sorted(self._kv.items())
-                    if k.startswith(msg["prefix"])
+                    if k.startswith(prefix)
                 ]
                 return {
                     "seq": seq,
@@ -481,6 +572,7 @@ class StoreServer:
                     "watch_id": watch_id,
                     "kvs": kvs,
                     "rev": self._revision,
+                    "incarnation": self.incarnation,
                 }
             if op == "unwatch":
                 self._watches.pop(msg["watch_id"], None)
@@ -538,11 +630,19 @@ class StoreServer:
                 return {"seq": seq, "ok": True,
                         "depth": len(q.items) if q else 0}
             if op == "ping":
-                return {"seq": seq, "ok": True, "rev": self._revision}
+                return {"seq": seq, "ok": True, "rev": self._revision,
+                        "incarnation": self.incarnation}
             return {"seq": seq, "ok": False, "error": f"unknown op {op!r}"}
         except Exception as exc:  # noqa: BLE001 — report, don't kill the conn
             log.exception("store op %s failed", op)
             return {"seq": seq, "ok": False, "error": str(exc)}
+
+    def _covers(self, since_rev: int) -> bool:
+        """True when the retained event history holds every revision after
+        ``since_rev`` (so a catch-up replay misses nothing)."""
+        if since_rev >= self._revision:
+            return True
+        return bool(self._history) and self._history[0][0] <= since_rev + 1
 
     def _q_pop_async(
         self, q: "_WorkQueue", msg: dict, writer: asyncio.StreamWriter
@@ -630,15 +730,23 @@ class StoreClient:
         self._recover_task: Optional[asyncio.Task] = None
         # how long reconnect attempts may run before declaring lease loss
         self.recover_timeout_s: float = 30.0
+        # reconnect pacing: jittered exponential backoff between attempts
+        self.reconnect_base_s: float = 0.25
+        self.reconnect_cap_s: float = 5.0
+        self._reconnect_rng = random.Random()
         self.num_recoveries = 0
 
     @staticmethod
     async def connect(
         addr: str, *, lease_ttl_s: float = 10.0, retries: int = 40,
-        retry_delay_s: float = 0.25,
+        retry_delay_s: float = 0.25, recover_timeout_s: float = 30.0,
+        reconnect_base_s: float = 0.25, reconnect_cap_s: float = 5.0,
     ) -> "StoreClient":
         host, port = addr.rsplit(":", 1)
         client = StoreClient(host, int(port))
+        client.recover_timeout_s = recover_timeout_s
+        client.reconnect_base_s = reconnect_base_s
+        client.reconnect_cap_s = reconnect_cap_s
         last: Optional[Exception] = None
         for _ in range(retries):
             try:
@@ -657,6 +765,14 @@ class StoreClient:
         return client
 
     async def _open(self) -> None:
+        fault = await faults.maybe_delay(
+            faults.active("store.connect", f"{self.host}:{self.port}")
+        )
+        if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+            # OSError so both the initial connect-retry loop and the
+            # recovery loop treat it exactly like a refused dial
+            raise OSError(f"injected store.connect fault "
+                          f"({self.host}:{self.port})")
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
@@ -788,6 +904,7 @@ class StoreClient:
         ``recover_timeout_s`` and fires ``on_lease_lost``.
         """
         deadline = time.monotonic() + self.recover_timeout_s
+        attempt = 0
         try:
             while not self._closed:
                 try:
@@ -827,7 +944,14 @@ class StoreClient:
                         if self.on_lease_lost:
                             self.on_lease_lost()
                         return
-                    await asyncio.sleep(0.5)
+                    # jittered exponential backoff: avoids a thundering herd
+                    # of reconnect dials when a whole cluster loses the store
+                    delay = min(
+                        self.reconnect_base_s * (2 ** attempt),
+                        self.reconnect_cap_s,
+                    ) * (0.5 + 0.5 * self._reconnect_rng.random())
+                    attempt += 1
+                    await asyncio.sleep(delay)
         finally:
             self._recover_task = None
 
@@ -876,14 +1000,16 @@ class StoreClient:
         return [(k, v) for k, v, _lease, _rev in resp.get("kvs", [])]
 
     async def delete(self, key: str) -> bool:
-        resp = await self._call({"op": "delete", "key": key})
+        # untrack BEFORE the RPC: if the store is down the delete raises, and
+        # a later lease recovery must not resurrect a key we meant to remove
         self._leased_keys.pop(key, None)
+        resp = await self._call({"op": "delete", "key": key})
         return bool(resp.get("deleted"))
 
     async def delete_prefix(self, prefix: str) -> int:
-        resp = await self._call({"op": "delete_prefix", "prefix": prefix})
         for key in [k for k in self._leased_keys if k.startswith(prefix)]:
             del self._leased_keys[key]
+        resp = await self._call({"op": "delete_prefix", "prefix": prefix})
         return int(resp.get("deleted", 0))
 
     async def lease_grant(self, ttl_s: float) -> int:
@@ -906,17 +1032,61 @@ class StoreClient:
             {"op": "unlock", "name": name, "lease": lease or self.primary_lease}
         )
 
+    async def _watch_raw(
+        self, prefix: str, *, since_rev: Optional[int] = None,
+        incarnation: Optional[str] = None,
+    ) -> Tuple[dict, "WatchStream"]:
+        """Low-level watch subscribe; returns the full server response (which
+        carries either a ``kvs`` snapshot or a ``caught_up`` event delta) plus
+        the claimed event stream."""
+        fault = await faults.maybe_delay(faults.active("store.watch", prefix))
+        if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+            raise StoreError(f"injected store.watch fault on {prefix!r}")
+        msg: dict = {"op": "watch", "prefix": prefix}
+        if since_rev is not None:
+            msg["since_rev"] = since_rev
+            msg["incarnation"] = incarnation
+        resp = await self._call(msg)
+        if not resp["ok"]:
+            raise StoreError(resp.get("error", "watch failed"))
+        return resp, WatchStream(
+            self, resp["watch_id"], self._claim_watch_queue(resp["watch_id"])
+        )
+
     async def watch_prefix(
         self, prefix: str
     ) -> Tuple[List[Tuple[str, bytes]], "WatchStream"]:
         """Subscribe to a prefix; returns (current snapshot, event stream)."""
-        resp = await self._call({"op": "watch", "prefix": prefix})
-        if not resp["ok"]:
-            raise StoreError(resp.get("error", "watch failed"))
-        watch_id = resp["watch_id"]
-        queue = self._claim_watch_queue(watch_id)
+        resp, stream = await self._watch_raw(prefix)
         snapshot = [(k, v) for k, v, _l, _r in resp.get("kvs", [])]
-        return snapshot, WatchStream(self, watch_id, queue)
+        return snapshot, stream
+
+    async def watch_prefix_resilient(
+        self, prefix: str, *, grace_s: float = 0.0,
+        rewatch_delay_s: float = 0.25,
+    ) -> Tuple[List[Tuple[str, bytes]], "ResilientWatchStream"]:
+        """Watch a prefix across store outages (stale-while-revalidate).
+
+        Like :meth:`watch_prefix`, but the returned stream survives dropped
+        watches and store restarts: it re-subscribes on its own, replays the
+        missed event delta when the server can still cover our revision
+        (same incarnation, history not overrun), and otherwise reconciles
+        against a fresh snapshot — emitting synthetic puts for new/changed
+        keys and synthetic deletes for keys that vanished. Deletes arising
+        from a reconcile are deferred ``grace_s`` seconds and re-verified
+        with a direct get, so keys whose owners are *also* mid-recovery
+        (their lease re-put races ours) aren't flapped out of the last-known
+        snapshot. During an outage consumers simply see no events and keep
+        serving ``stream.state`` — the last-known view."""
+        resp, inner = await self._watch_raw(prefix)
+        snapshot = [(k, v) for k, v, _l, _r in resp.get("kvs", [])]
+        stream = ResilientWatchStream(
+            self, prefix, inner, snapshot,
+            last_rev=resp.get("rev", 0),
+            incarnation=resp.get("incarnation"),
+            grace_s=grace_s, rewatch_delay_s=rewatch_delay_s,
+        )
+        return snapshot, stream
 
     def _claim_watch_queue(self, watch_id: int) -> asyncio.Queue:
         """Register the event queue, draining any events that arrived between
@@ -1031,6 +1201,189 @@ class WatchStream:
         # events in flight between pop and the unwatch ack land in the orphan
         # buffer; discard them so cancelled watches don't leak memory
         self._client._orphan_events.pop(self.watch_id, None)
+
+
+class ResilientWatchStream:
+    """A prefix watch that outlives dropped watches and store restarts.
+
+    Same ``next()`` contract as :class:`WatchStream` (None == client closed
+    for good), but ``'dropped'`` never reaches the consumer: the stream
+    re-subscribes, replays the missed delta when the server still covers our
+    revision, and otherwise reconciles a fresh snapshot into synthetic
+    put/delete events. ``state`` is the last-known key->value view — safe to
+    read at any time, including mid-outage (stale-while-revalidate).
+    """
+
+    def __init__(
+        self,
+        client: StoreClient,
+        prefix: str,
+        inner: WatchStream,
+        snapshot: List[Tuple[str, bytes]],
+        *,
+        last_rev: int = 0,
+        incarnation: Optional[str] = None,
+        grace_s: float = 0.0,
+        rewatch_delay_s: float = 0.25,
+    ):
+        self._client = client
+        self.prefix = prefix
+        self._inner = inner
+        self.state: Dict[str, bytes] = dict(snapshot)
+        self.last_rev = last_rev
+        self.incarnation = incarnation
+        self.grace_s = grace_s
+        self.rewatch_delay_s = rewatch_delay_s
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._pending_stale: Dict[str, asyncio.Task] = {}
+        self.num_resyncs = 0
+        self.num_catchups = 0
+        self._driver = asyncio.create_task(self._run())
+
+    def _track(self, event: dict) -> None:
+        key = event.get("key")
+        if event["event"] == "put":
+            self.state[key] = event.get("value")
+            self._cancel_stale(key)
+        elif event["event"] == "delete":
+            self.state.pop(key, None)
+            self._cancel_stale(key)
+        self.last_rev = max(self.last_rev, event.get("rev") or 0)
+
+    def _cancel_stale(self, key: str) -> None:
+        task = self._pending_stale.pop(key, None)
+        if task is not None:
+            task.cancel()
+
+    async def _run(self) -> None:
+        while True:
+            event = await self._inner.next()
+            if event is None:
+                self._out.put_nowait(None)
+                return
+            if event["event"] == "dropped":
+                if not await self._resync():
+                    self._out.put_nowait(None)
+                    return
+                continue
+            self._track(event)
+            self._out.put_nowait(event)
+
+    async def _resync(self) -> bool:
+        """Re-subscribe after a drop; replay the delta or reconcile a
+        snapshot. Returns False only when the client itself is closed."""
+        # the old watch belongs to a dead (or shed) server registration;
+        # drop the local queue and best-effort unwatch
+        try:
+            await self._inner.cancel()
+        except Exception:
+            pass
+        while True:
+            if self._client._closed:
+                return False
+            try:
+                resp, inner = await self._client._watch_raw(
+                    self.prefix, since_rev=self.last_rev,
+                    incarnation=self.incarnation,
+                )
+                break
+            except (StoreError, OSError):
+                # store still down (or mid-recovery) — the consumer keeps
+                # serving ``state`` while we retry
+                await asyncio.sleep(self.rewatch_delay_s)
+        self._inner = inner
+        self.num_resyncs += 1
+        self.incarnation = resp.get("incarnation")
+        if resp.get("caught_up"):
+            self.num_catchups += 1
+            for event in resp.get("events", []):
+                self._track(event)
+                self._out.put_nowait(event)
+            self.last_rev = max(self.last_rev, resp.get("rev") or 0)
+            return True
+        # snapshot reconcile: diff last-known state against the fresh view
+        live = {k: v for k, v, _l, _r in resp.get("kvs", [])}
+        rev = resp.get("rev") or 0
+        for key, value in live.items():
+            if self.state.get(key) != value:
+                event = {"event": "put", "key": key, "value": value,
+                         "rev": rev, "resync": True}
+                self._track(event)
+                self._out.put_nowait(event)
+        for key in [k for k in self.state if k not in live]:
+            if self.grace_s <= 0:
+                event = {"event": "delete", "key": key, "value": None,
+                         "rev": rev, "resync": True}
+                self._track(event)
+                self._out.put_nowait(event)
+            elif key not in self._pending_stale:
+                # the key's owner may itself be mid-recovery (its lease
+                # re-put races our re-watch) — verify before evicting
+                self._pending_stale[key] = asyncio.create_task(
+                    self._stale_check(key)
+                )
+        self.last_rev = max(self.last_rev, rev)
+        return True
+
+    async def _stale_check(self, key: str) -> None:
+        try:
+            await asyncio.sleep(self.grace_s)
+            while True:
+                try:
+                    value = await self._client.get(key)
+                    break
+                except (StoreError, OSError):
+                    if self._client._closed:
+                        return
+                    await asyncio.sleep(self.rewatch_delay_s)
+            if value is None and key in self.state:
+                event = {"event": "delete", "key": key, "value": None,
+                         "rev": self.last_rev, "resync": True}
+                self.state.pop(key, None)
+                self._out.put_nowait(event)
+            elif value is not None and self.state.get(key) != value:
+                event = {"event": "put", "key": key, "value": value,
+                         "rev": self.last_rev, "resync": True}
+                self.state[key] = value
+                self._out.put_nowait(event)
+        finally:
+            self._pending_stale.pop(key, None)
+
+    async def reconcile(self) -> Dict[str, List[str]]:
+        """Diff the last-known view against the store. Empty lists mean the
+        stream has fully converged with the live store."""
+        live = dict(await self._client.get_prefix(self.prefix))
+        return {
+            "missing": sorted(k for k in self.state if k not in live),
+            "extra": sorted(k for k in live if k not in self.state),
+            "changed": sorted(
+                k for k, v in self.state.items()
+                if k in live and live[k] != v
+            ),
+        }
+
+    async def next(self) -> Optional[dict]:
+        return await self._out.get()
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            event = await self._out.get()
+            if event is None:
+                return
+            yield event
+
+    async def cancel(self) -> None:
+        self._driver.cancel()
+        for task in list(self._pending_stale.values()):
+            task.cancel()
+        self._pending_stale.clear()
+        try:
+            await self._inner.cancel()
+        except Exception:
+            pass
 
 
 def main() -> None:

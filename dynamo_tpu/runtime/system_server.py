@@ -32,6 +32,9 @@ class SystemServer:
         self.host = host
         self.port = port
         self._probes: Dict[str, HealthProbe] = {}
+        # admin drain triggers: name -> zero-arg callable kicking off a
+        # graceful drain (same path as SIGINT/SIGTERM)
+        self._drain_handlers: Dict[str, Callable[[], None]] = {}
         self._live = True
         self._runner: Optional[web.AppRunner] = None
 
@@ -41,6 +44,9 @@ class SystemServer:
     def unregister_probe(self, name: str) -> None:
         self._probes.pop(name, None)
 
+    def register_drain(self, name: str, handler: Callable[[], None]) -> None:
+        self._drain_handlers[name] = handler
+
     def set_live(self, live: bool) -> None:
         self._live = live
 
@@ -49,6 +55,7 @@ class SystemServer:
         app.add_routes([
             web.get("/health", self._health),
             web.get("/live", self._livez),
+            web.post("/drain", self._drain),
             web.get("/metrics", self._metrics),
             web.get("/debug/traces", self._traces),
             web.get("/debug/traces/{trace_id}", self._trace),
@@ -84,6 +91,22 @@ class SystemServer:
              "probes": detail},
             status=status,
         )
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """Admin drain trigger: stop routing here, finish or migrate
+        in-flight work, then exit clean. 202 — the drain runs async."""
+        if not self._drain_handlers:
+            return web.json_response(
+                {"error": "nothing drainable registered"}, status=404
+            )
+        fired = []
+        for name, handler in list(self._drain_handlers.items()):
+            try:
+                handler()
+                fired.append(name)
+            except Exception:
+                log.exception("drain handler %s failed", name)
+        return web.json_response({"draining": fired}, status=202)
 
     async def _livez(self, request: web.Request) -> web.Response:
         return web.json_response({"live": self._live},

@@ -44,6 +44,9 @@ ERR_APP = "application"          # handler raised — not retryable
 ERR_UNAVAILABLE = "unavailable"  # connect failed / conn dropped — retryable
 ERR_OVERLOADED = "overloaded"    # worker rejected (busy threshold) — retryable
 ERR_TIMEOUT = "deadline_exceeded"  # request deadline hit — NOT retryable
+# planned drain: retryable divert-elsewhere, but NOT a failure signal — the
+# router must never feed a draining rejection into a circuit breaker
+ERR_DRAINING = "draining"
 
 # request header carrying the remaining deadline budget in milliseconds;
 # relative (not absolute) so clocks never need to agree across hosts
@@ -95,10 +98,38 @@ class IngressServer:
             self._server.close()
             await self._server.wait_closed()
 
-    async def join(self) -> None:
-        """Wait for in-flight requests to finish (graceful shutdown drain)."""
+    async def join(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait for in-flight requests to finish (graceful shutdown drain).
+        Returns False when ``timeout_s`` elapsed with requests still live."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
         while self._inflight:
-            await asyncio.wait(list(self._inflight.values()))
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+            await asyncio.wait(list(self._inflight.values()), timeout=remaining)
+        return True
+
+    async def drain(self, deadline_s: Optional[float] = None,
+                    stop_grace_s: float = 2.0) -> bool:
+        """Graceful drain: reject new work as ``draining``, wait for in-flight
+        streams up to ``deadline_s``, then stop the stragglers gracefully.
+
+        A deadline-stopped stream emits its tokens-so-far and ends WITHOUT a
+        ``finished`` marker, which the client's Migration operator re-issues
+        on another worker with token carryover — in-flight decodes migrate
+        instead of dying. Returns True when fully drained."""
+        self.draining = True
+        if await self.join(deadline_s):
+            return True
+        log.warning(
+            "drain deadline (%.1fs) hit with %d in-flight — stopping "
+            "streams so clients migrate", deadline_s, len(self._inflight),
+        )
+        for ctx in list(self._contexts.values()):
+            ctx.stop_generating()
+        return await self.join(stop_grace_s)
 
     @property
     def num_inflight(self) -> int:
@@ -169,7 +200,7 @@ class IngressServer:
         # rejected request must leave no context/accounting behind
         if self.draining:
             await send({"t": "err", "rid": rid, "error": "draining",
-                        "code": ERR_UNAVAILABLE})
+                        "code": ERR_DRAINING})
             return
         fault = faults.active("worker.admit", rid)
         if fault is not None and fault.kind == faults.REJECT:

@@ -95,8 +95,18 @@ async def serve_engine(
             HealthCheckConfig, HealthCheckManager, engine_canary,
         )
 
+        def _withdraw(name: str) -> None:
+            log.warning("health probe %s unhealthy — withdrawing instance", name)
+            asyncio.ensure_future(served.withdraw())
+
+        def _readvertise(name: str) -> None:
+            log.info("health probe %s recovered — re-advertising instance", name)
+            asyncio.ensure_future(served.readvertise())
+
         health = HealthCheckManager(
-            HealthCheckConfig(period_s=runtime.config.health_check_period_s)
+            HealthCheckConfig(period_s=runtime.config.health_check_period_s),
+            on_unhealthy=_withdraw,
+            on_recovered=_readvertise,
         )
         target = f"{opts.component}/{opts.endpoint}"
         health.register(target, engine_canary(
@@ -150,18 +160,26 @@ async def run_until_shutdown(
     runtime: DistributedRuntime, engine: EngineCore,
     served, kv_pub, metrics_pub,
 ) -> None:
-    """Install signal-driven graceful drain, then block on runtime shutdown."""
+    """Install the graceful drain triggers (SIGINT/SIGTERM and, when the
+    system server is up, ``POST /drain``), then block on runtime shutdown."""
     loop = asyncio.get_running_loop()
+    drained = {"fired": False}
 
     def _graceful():
-        log.info("signal received — draining")
+        if drained["fired"]:
+            return  # a second signal / POST must not start a second drain
+        drained["fired"] = True
+        log.info("drain requested — deregistering and finishing in-flight "
+                 "work (deadline %.1fs)", runtime.config.drain_timeout_s)
         asyncio.ensure_future(_shutdown())
 
     async def _shutdown():
         health = getattr(served, "health_manager", None)
         if health is not None:
             await health.stop()
-        await served.drain_and_stop()
+        await served.drain_and_stop(
+            deadline_s=runtime.config.drain_timeout_s
+        )
         await kv_pub.stop()
         await metrics_pub.stop()
         await engine.stop()
@@ -169,6 +187,8 @@ async def run_until_shutdown(
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, _graceful)
+    if runtime.system_server is not None:
+        runtime.system_server.register_drain(served.endpoint.path, _graceful)
 
     await runtime.shutdown_event.wait()
 

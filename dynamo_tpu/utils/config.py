@@ -67,6 +67,17 @@ class RuntimeConfig:
     health_check_enabled: bool = False
     health_check_period_s: float = 10.0
     lease_ttl_s: float = 10.0  # ref: transports/etcd.rs:89-95 (10 s TTL)
+    # graceful drain: in-flight streams get this long to finish before they
+    # are stopped (clients migrate the remainder to another worker)
+    drain_timeout_s: float = 30.0
+    # store-outage survival: how long the client retries reconnecting before
+    # declaring the lease lost, and the jittered-backoff pacing of the dials
+    store_recover_timeout_s: float = 30.0
+    store_reconnect_base_s: float = 0.25
+    store_reconnect_cap_s: float = 5.0
+    # after a snapshot reconcile, keys missing from the snapshot are only
+    # evicted once they stay gone this long (their owner may be re-putting)
+    store_reconcile_grace_s: float = 3.0
     jsonl_logging: bool = False
     log_level: str = "INFO"
     num_io_threads: int = 8
@@ -115,6 +126,21 @@ class RuntimeConfig:
             ENV_PREFIX + "HEALTH_CHECK_PERIOD_S", cfg.health_check_period_s
         )
         cfg.lease_ttl_s = env_float(ENV_PREFIX + "LEASE_TTL_S", cfg.lease_ttl_s)
+        cfg.drain_timeout_s = env_float(
+            ENV_PREFIX + "DRAIN_TIMEOUT_S", cfg.drain_timeout_s
+        )
+        cfg.store_recover_timeout_s = env_float(
+            ENV_PREFIX + "STORE_RECOVER_TIMEOUT_S", cfg.store_recover_timeout_s
+        )
+        cfg.store_reconnect_base_s = env_float(
+            ENV_PREFIX + "STORE_RECONNECT_BASE_S", cfg.store_reconnect_base_s
+        )
+        cfg.store_reconnect_cap_s = env_float(
+            ENV_PREFIX + "STORE_RECONNECT_CAP_S", cfg.store_reconnect_cap_s
+        )
+        cfg.store_reconcile_grace_s = env_float(
+            ENV_PREFIX + "STORE_RECONCILE_GRACE_S", cfg.store_reconcile_grace_s
+        )
         cfg.jsonl_logging = env_flag(ENV_PREFIX + "JSONL_LOGGING", cfg.jsonl_logging)
         cfg.log_level = env_str(ENV_PREFIX + "LOG_LEVEL", cfg.log_level)
         cfg.num_io_threads = env_int(ENV_PREFIX + "IO_THREADS", cfg.num_io_threads)

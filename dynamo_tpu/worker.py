@@ -66,6 +66,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "GPipe-microbatched decode; exclusive with --mesh)")
     p.add_argument("--pp-microbatches", type=int, default=4)
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   help="seconds in-flight streams get to finish on graceful "
+                        "drain before being stopped for client migration "
+                        "(default: DYNTPU_DRAIN_TIMEOUT_S, 30)")
     p.add_argument("--advertise-host", default="127.0.0.1")
     p.add_argument(
         "--disagg-mode", default="agg", choices=["agg", "decode", "prefill"],
@@ -124,6 +128,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         config.store_addr = args.store_addr
     if args.namespace:
         config.namespace = args.namespace
+    if args.drain_timeout is not None:
+        config.drain_timeout_s = args.drain_timeout
 
     from .parallel.multihost import MultihostConfig, initialize_distributed
 

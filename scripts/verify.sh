@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
-# Repo verification: the ROADMAP.md tier-1 line, plus a fast tracing-only
-# mode for quick iteration on the observability stack.
+# Repo verification: the ROADMAP.md tier-1 line, plus fast targeted modes
+# for quick iteration on individual subsystems.
 #
-#   scripts/verify.sh            # full tier-1 suite (what CI gates on)
-#   scripts/verify.sh tracing    # just the -m tracing suite (seconds)
+#   scripts/verify.sh             # full tier-1 suite (what CI gates on)
+#   scripts/verify.sh tracing     # just the -m tracing suite (seconds)
+#   scripts/verify.sh resilience  # fault-injection + chaos suites
+#   scripts/verify.sh chaos       # seeded chaos sweep; echoes the repro
+#                                 # seed (DYNTPU_CHAOS_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -11,6 +14,28 @@ cd "$(dirname "$0")/.."
 if [ "${1:-}" = "tracing" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tracing \
         -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "resilience" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'resilience or chaos' -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "chaos" ]; then
+    set -o pipefail
+    rm -f /tmp/_chaos.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+        -p no:cacheprovider 2>&1 | tee /tmp/_chaos.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every chaos test prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'CHAOS_SEED=[0-9]+' /tmp/_chaos.log | sort -u | tr '\n' ' ')
+        echo "chaos sweep FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh chaos"
+        done
+    fi
+    exit $rc
 fi
 
 # Tier-1 (ROADMAP.md): full suite minus slow markers, with a parseable

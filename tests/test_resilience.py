@@ -34,7 +34,7 @@ from dynamo_tpu.runtime.engine import AsyncEngine, FnEngine
 from dynamo_tpu.runtime.health import HealthCheckConfig, HealthCheckManager
 from dynamo_tpu.runtime.store import StoreServer
 from dynamo_tpu.runtime.transport import (
-    ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE, EngineError,
+    ERR_DRAINING, ERR_OVERLOADED, ERR_TIMEOUT, ERR_UNAVAILABLE, EngineError,
 )
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.metrics import MetricsRegistry
@@ -527,6 +527,194 @@ async def test_admission_controller_cancelled_waiter_hands_slot_on():
     with pytest.raises(AdmissionError):
         ac2 = AdmissionController(0, max_queue=0)
         await ac2.acquire()
+
+
+# ------------------------------- drain -----------------------------------
+
+
+async def test_draining_server_rejects_with_draining_code(cluster):
+    """A draining ingress refuses late arrivals with the retryable
+    ``draining`` status, not a generic failure."""
+    served = cluster["serveds"][0]
+    served.server.draining = True
+    try:
+        stream = cluster["client"].direct(
+            served.instance.instance_id,
+            {"token_ids": [1], "max_tokens": 2}, Context(),
+        )
+        with pytest.raises(EngineError) as ei:
+            async for _ in stream:
+                pass
+        assert ei.value.code == ERR_DRAINING
+    finally:
+        served.server.draining = False
+
+
+async def test_draining_diverts_without_tripping_breaker(cluster):
+    """The router treats a draining rejection as divert-elsewhere: the worker
+    goes into the divert set and its breaker records NO failure."""
+    reg = CircuitBreakerRegistry(BreakerConfig(failure_threshold=1,
+                                               open_timeout_s=60.0))
+    router = _router(cluster, breakers=reg, busy_threshold=0.5)
+    sink = KvPushRouter(router)
+    w1_id = cluster["serveds"][0].instance.instance_id
+    w2_id = cluster["serveds"][1].instance.instance_id
+    cluster["serveds"][0].server.draining = True
+    # force selection of the draining worker 1 (worker 2 reported busy)
+    router.worker_stats[w2_id] = {"worker_id": w2_id, "kv_usage": 1.0}
+    try:
+        with pytest.raises(EngineError) as ei:
+            await _collect(sink, {"token_ids": [1], "max_tokens": 2},
+                           Context())
+        assert ei.value.code == ERR_DRAINING
+        assert w1_id in router.draining
+        # even with failure_threshold=1, the breaker never saw a failure
+        assert reg.breaker(w1_id).state == CLOSED
+        assert reg.breaker(w1_id).num_trips == 0
+        # worker 2 back in rotation: the divert set steers traffic there
+        router.worker_stats.pop(w2_id)
+        mig = Migration(sink, migration_limit=2, backoff_base_s=0.005,
+                        rng=random.Random(0))
+        out = await _collect(mig, {"token_ids": [1], "max_tokens": 3},
+                             Context())
+        assert [t for o in out for t in o["token_ids"]] == [1001, 1002, 1003]
+        assert not cluster["workers"][0].requests
+        assert len(cluster["workers"][1].requests) == 1
+        # every worker draining → unavailable, still no breaker involvement
+        router.mark_draining(w2_id)
+        with pytest.raises(EngineError) as ei:
+            router.find_best_match("rid-drain", [1, 2, 3])
+        assert ei.value.code == ERR_UNAVAILABLE
+        assert "draining" in str(ei.value)
+    finally:
+        cluster["serveds"][0].server.draining = False
+        router.draining.clear()
+
+
+async def test_drain_deadline_migrates_inflight_with_token_parity(cluster):
+    """``drain_and_stop`` past its deadline stops the straggler stream; the
+    client migrates and still sees every token exactly once, and the
+    instance key is gone from the store."""
+    w1, w2 = cluster["workers"]
+    w1.delay_s = w2.delay_s = 0.03
+    reg = CircuitBreakerRegistry(BreakerConfig(failure_threshold=1,
+                                               open_timeout_s=60.0))
+    router = _router(cluster, breakers=reg, busy_threshold=0.5)
+    sink = KvPushRouter(router)
+    mig = Migration(sink, migration_limit=3, backoff_base_s=0.005,
+                    rng=random.Random(1))
+    served1 = cluster["serveds"][0]
+    w2_id = cluster["serveds"][1].instance.instance_id
+    # pin the request onto worker 1, then free worker 2 for the migration
+    router.worker_stats[w2_id] = {"worker_id": w2_id, "kv_usage": 1.0}
+    task = asyncio.create_task(
+        _collect(mig, {"token_ids": [1, 2], "max_tokens": 12}, Context())
+    )
+    for _ in range(200):
+        if w1.requests:
+            break
+        await asyncio.sleep(0.005)
+    assert w1.requests
+    router.worker_stats.pop(w2_id)
+    # deadline far shorter than the remaining stream → stop + migrate
+    await served1.drain_and_stop(deadline_s=0.05, stop_grace_s=2.0)
+    out = await task
+    toks = [t for o in out for t in o["token_ids"]]
+    assert toks == [1002 + i for i in range(12)]
+    assert out[-1]["finished"]
+    assert w2.requests and w2.requests[0]["token_ids"][:2] == [1, 2]
+    # deregistered: the instance key is gone and no breaker ever tripped
+    store = cluster["front"].store
+    assert await store.get(served1.instance.key) is None
+    assert reg.breaker(served1.instance.instance_id).num_trips == 0
+
+
+async def test_system_server_drain_endpoint():
+    """POST /drain fires the registered drain trigger (202); with nothing
+    registered it 404s."""
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    srv = SystemServer(port=0)
+    await srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/drain") as r:
+                assert r.status == 404
+            fired = []
+            srv.register_drain("ns/backend/generate",
+                               lambda: fired.append(1))
+            async with s.post(f"{base}/drain") as r:
+                assert r.status == 202
+                body = await r.json()
+                assert body["draining"] == ["ns/backend/generate"]
+            assert fired == [1]
+            # idempotent trigger contract is the handler's job; the endpoint
+            # just fires it again
+            async with s.post(f"{base}/drain") as r:
+                assert r.status == 202
+    finally:
+        await srv.stop()
+
+
+async def test_health_withdraw_and_readvertise(cluster):
+    """An unhealthy canary withdraws the instance key (routing stops); the
+    recovery re-advertises the identical record (routing resumes)."""
+    served = cluster["serveds"][0]
+    store = cluster["front"].store
+    client = cluster["client"]
+    ok = [False]
+
+    async def probe():
+        if not ok[0]:
+            raise RuntimeError("canary failed")
+
+    mgr = HealthCheckManager(
+        HealthCheckConfig(period_s=0.01, timeout_s=0.2, failure_threshold=2),
+        on_unhealthy=lambda name: asyncio.ensure_future(served.withdraw()),
+        on_recovered=lambda name: asyncio.ensure_future(served.readvertise()),
+    )
+    mgr.register("backend/generate", probe)
+    mgr.start()
+    try:
+        for _ in range(300):
+            if await store.get(served.instance.key) is None:
+                break
+            await asyncio.sleep(0.01)
+        assert await store.get(served.instance.key) is None
+        for _ in range(300):
+            if served.instance.instance_id not in client.instances:
+                break
+            await asyncio.sleep(0.01)
+        assert served.instance.instance_id not in client.instances
+        ok[0] = True
+        for _ in range(300):
+            if await store.get(served.instance.key) is not None:
+                break
+            await asyncio.sleep(0.01)
+        record = await store.get(served.instance.key)
+        assert record is not None
+        import msgpack as _msgpack
+        assert _msgpack.unpackb(record, raw=False)["addr"] == \
+            served.instance.addr
+        await client.wait_for_instances(2, timeout_s=10.0)
+    finally:
+        await mgr.stop()
+
+
+async def test_readvertise_noop_while_draining(cluster):
+    """A recovered-but-draining worker must stay withdrawn."""
+    served = cluster["serveds"][0]
+    store = cluster["front"].store
+    served.server.draining = True
+    try:
+        await served.withdraw()
+        await served.readvertise()
+        assert await store.get(served.instance.key) is None
+    finally:
+        served.server.draining = False
+        await served.readvertise()
+        assert await store.get(served.instance.key) is not None
 
 
 # ------------------------------ store faults ------------------------------

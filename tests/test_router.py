@@ -195,6 +195,7 @@ def test_busy_threshold_rejection():
     router.loads = PotentialLoads(BS)
     router.worker_stats = {1: {"kv_usage": 0.95}, 2: {"kv_usage": 0.9}}
     router.breakers = CircuitBreakerRegistry()
+    router.draining = set()
     router._rng = random.Random(0)
     with pytest.raises(EngineError) as exc:
         router.find_best_match("r1", list(range(8)))

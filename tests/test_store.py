@@ -2,6 +2,7 @@
 (capability contract of ref transports/etcd.rs + nats.rs)."""
 
 import asyncio
+import time
 
 import pytest
 
@@ -206,3 +207,86 @@ async def test_watch_catches_immediate_events(store):
         seen.append(event["key"])
         await stream.cancel()
     assert seen == [f"race/{i}" for i in range(50)]
+
+
+# --------------------------- lease expiry races ---------------------------
+
+
+async def test_late_keepalive_does_not_resurrect_lease():
+    """A keepalive that lands after the deadline but before the expire-loop
+    tick must fail with ``lease_expired`` — never extend the dead lease —
+    and the leased keys go away."""
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    c = await StoreClient.connect(f"127.0.0.1:{server.port}", lease_ttl_s=30.0)
+    try:
+        c._keepalive_task.cancel()  # drive keepalives by hand
+        await c.put("race/w1", b"addr", lease=c.primary_lease)
+        # simulate the race: deadline crossed, expire loop not yet ticked
+        server._leases[c.primary_lease].deadline = time.monotonic() - 0.01
+        resp = await c._call(
+            {"op": "lease_keepalive", "lease": c.primary_lease}
+        )
+        assert resp["ok"] is False
+        assert resp["error"] == "lease_expired"
+        # the keepalive settled the race by revoking: key gone, lease gone
+        assert await c.get("race/w1") is None
+        assert c.primary_lease not in server._leases
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_keepalive_before_deadline_extends_across_ttls():
+    """Keepalives that land in time keep extending: the lease survives well
+    past several TTLs and watchers see zero deletes."""
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    c = await StoreClient.connect(f"127.0.0.1:{server.port}", lease_ttl_s=30.0)
+    watcher = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        c._keepalive_task.cancel()
+        lease = await c.lease_grant(0.6)
+        await c.put("alive/w1", b"addr", lease=lease)
+        _snap, stream = await watcher.watch_prefix("alive/")
+        for _ in range(4):   # 1.2 s total = 2 TTLs, refreshed every 0.3 s
+            await asyncio.sleep(0.3)
+            resp = await c._call({"op": "lease_keepalive", "lease": lease})
+            assert resp["ok"] is True
+        assert await c.get("alive/w1") == b"addr"
+        assert stream._queue.qsize() == 0   # no delete ever fired
+        await stream.cancel()
+    finally:
+        await c.close()
+        await watcher.close()
+        await server.stop()
+
+
+async def test_expiry_notifies_watchers_exactly_once():
+    """Expiry via the loop plus a racing explicit revoke must not double-
+    delete: watchers see exactly one delete per leased key."""
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    c = await StoreClient.connect(f"127.0.0.1:{server.port}", lease_ttl_s=30.0)
+    watcher = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        c._keepalive_task.cancel()
+        lease = await c.lease_grant(30.0)
+        await c.put("once/w1", b"addr", lease=lease)
+        _snap, stream = await watcher.watch_prefix("once/")
+        server._leases[lease].deadline = time.monotonic() - 0.01
+        # racing revokes: the expire-loop tick and an explicit revoke
+        server._revoke(lease)
+        server._revoke(lease)
+        await asyncio.sleep(0.6)  # let the expire loop tick over the corpse
+        event = await asyncio.wait_for(stream.next(), timeout=2)
+        assert event["event"] == "delete" and event["key"] == "once/w1"
+        # no second delete: the next event the watcher sees is a fresh put
+        await watcher.put("once/marker", b"m")
+        event = await asyncio.wait_for(stream.next(), timeout=2)
+        assert event["event"] == "put" and event["key"] == "once/marker"
+        await stream.cancel()
+    finally:
+        await c.close()
+        await watcher.close()
+        await server.stop()

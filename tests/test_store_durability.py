@@ -164,3 +164,211 @@ async def test_cluster_survives_store_kill9(tokenizer_file, tmp_path):
                 p.terminate()
             except Exception:
                 pass
+
+
+# --------------------- truncated / corrupt snapshots ----------------------
+
+
+async def _seed_snapshot(path, n=10):
+    """Write a snapshot with n sorted unleased keys and one queue."""
+    s = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s.start()
+    c = await StoreClient.connect(f"127.0.0.1:{s.port}")
+    for i in range(n):
+        await c.put(f"durable/{i:02d}", f"v{i}".encode())
+    await c.q_push("jobs", b"j1")
+    await c.close()
+    await s.stop()  # final persist
+
+
+def _frame_offsets(path):
+    """Byte offset after each msgpack frame in the snapshot."""
+    import msgpack
+
+    data = Path(path).read_bytes()
+    unpacker = msgpack.Unpacker(raw=False)
+    unpacker.feed(data)
+    offsets = []
+    for _ in unpacker:
+        offsets.append(unpacker.tell())
+    return data, offsets
+
+
+async def test_restore_tolerates_truncated_trailing_frame(tmp_path):
+    """A crash mid-write leaves a partial trailing frame: restore keeps
+    every record before it and the store starts serving."""
+    path = str(tmp_path / "store.snap")
+    await _seed_snapshot(path, n=10)
+    data, offsets = _frame_offsets(path)
+    # layout: header, 10 kv frames, 1 queue frame, eof
+    assert len(offsets) == 13
+    # chop mid-way through the LAST kv frame (frame index 10, after 9 kvs)
+    Path(path).write_bytes(data[: offsets[9] + 2])
+    s = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s.start()
+    c = await StoreClient.connect(f"127.0.0.1:{s.port}")
+    for i in range(9):
+        assert await c.get(f"durable/{i:02d}") == f"v{i}".encode()
+    assert await c.get("durable/09") is None   # the truncated record
+    # the store is live: writes work and persist again
+    await c.put("durable/new", b"nv")
+    assert await c.get("durable/new") == b"nv"
+    await c.close()
+    await s.stop()
+
+
+async def test_restore_tolerates_missing_eof_and_garbage_tail(tmp_path):
+    """Snapshot missing only its eof marker (or with garbage appended)
+    restores every record."""
+    path = str(tmp_path / "store.snap")
+    await _seed_snapshot(path, n=5)
+    data, offsets = _frame_offsets(path)
+    # drop the eof frame entirely
+    Path(path).write_bytes(data[: offsets[-2]])
+    s = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s.start()
+    c = await StoreClient.connect(f"127.0.0.1:{s.port}")
+    for i in range(5):
+        assert await c.get(f"durable/{i:02d}") == f"v{i}".encode()
+    assert await c.q_pop("jobs", timeout_s=2) == b"j1"
+    await c.close()
+    await s.stop()
+    # garbage after a valid prefix of the file: also fine
+    Path(path).write_bytes(data[: offsets[-2]] + b"\xc1\xc1garbage")
+    s2 = StoreServer("127.0.0.1", 0, persist_path=path)
+    await s2.start()
+    c2 = await StoreClient.connect(f"127.0.0.1:{s2.port}")
+    assert await c2.get("durable/04") == b"v4"
+    await c2.close()
+    await s2.stop()
+
+
+async def test_restore_reads_legacy_single_blob(tmp_path):
+    """Snapshots from the pre-framed format (one msgpack blob) restore."""
+    import msgpack
+
+    path = tmp_path / "store.snap"
+    path.write_bytes(msgpack.packb(
+        {"revision": 7,
+         "kv": [["durable/a", b"v1"], ["durable/b", b"v2"]],
+         "queues": {"jobs": [b"j1", b"j2"]}},
+        use_bin_type=True,
+    ))
+    s = StoreServer("127.0.0.1", 0, persist_path=str(path))
+    await s.start()
+    c = await StoreClient.connect(f"127.0.0.1:{s.port}")
+    assert await c.get("durable/a") == b"v1"
+    assert await c.get("durable/b") == b"v2"
+    assert await c.q_len("jobs") == 2
+    await c.close()
+    await s.stop()
+
+
+# ------------------------ resilient watch resync --------------------------
+
+
+async def test_resilient_watch_catches_up_from_revision(tmp_path):
+    """A shed watch (same server incarnation) re-subscribes with its last
+    revision and replays exactly the missed events — no snapshot diff."""
+    server = StoreServer("127.0.0.1", 0)
+    await server.start()
+    watcher = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    writer = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        await writer.put("cu/a", b"1")
+        snap, stream = await watcher.watch_prefix_resilient(
+            "cu/", grace_s=0.0, rewatch_delay_s=0.05
+        )
+        assert [k for k, _ in snap] == ["cu/a"]
+        await writer.put("cu/b", b"2")
+        ev = await asyncio.wait_for(stream.next(), 2)
+        assert ev["event"] == "put" and ev["key"] == "cu/b"
+        # shed the watch server-side, miss two events, then learn of it
+        wid = stream._inner.watch_id
+        server._watches.pop(wid)
+        await writer.put("cu/c", b"3")
+        await writer.delete("cu/a")
+        watcher._watch_queues[wid].put_nowait(
+            {"watch_id": wid, "event": "dropped", "key": "", "value": None,
+             "rev": 0}
+        )
+        ev1 = await asyncio.wait_for(stream.next(), 2)
+        ev2 = await asyncio.wait_for(stream.next(), 2)
+        assert (ev1["event"], ev1["key"]) == ("put", "cu/c")
+        assert (ev2["event"], ev2["key"]) == ("delete", "cu/a")
+        assert stream.num_resyncs == 1 and stream.num_catchups == 1
+        assert stream.state == {"cu/b": b"2", "cu/c": b"3"}
+        diff = await stream.reconcile()
+        assert diff == {"missing": [], "extra": [], "changed": []}
+        await stream.cancel()
+    finally:
+        await watcher.close()
+        await writer.close()
+        await server.stop()
+
+
+async def test_resilient_watch_survives_store_restart(tmp_path):
+    """Across a store restart the consumer keeps its last-known view (no
+    spurious deletes), the stream resyncs via snapshot reconcile, and the
+    view converges to the live store."""
+    path = str(tmp_path / "store.snap")
+    port = free_port()
+    s1 = StoreServer("127.0.0.1", port, persist_path=path)
+    await s1.start()
+    worker = await StoreClient.connect(
+        f"127.0.0.1:{port}", reconnect_base_s=0.05, reconnect_cap_s=0.2
+    )
+    watcher = await StoreClient.connect(
+        f"127.0.0.1:{port}", reconnect_base_s=0.05, reconnect_cap_s=0.2
+    )
+    events = []
+    try:
+        await worker.put("rw/leased", b"claim", lease=worker.primary_lease)
+        await worker.put("rw/durable", b"kept")
+        snap, stream = await watcher.watch_prefix_resilient(
+            "rw/", grace_s=1.5, rewatch_delay_s=0.05
+        )
+        assert len(snap) == 2
+
+        async def consume():
+            while True:
+                ev = await stream.next()
+                if ev is None:
+                    return
+                events.append(ev)
+
+        consumer = asyncio.create_task(consume())
+        await s1.stop()
+        await asyncio.sleep(0.2)
+        # mid-outage: the stale view still serves both keys
+        assert stream.state == {"rw/leased": b"claim", "rw/durable": b"kept"}
+
+        s2 = StoreServer("127.0.0.1", port, persist_path=path)
+        await s2.start()
+        for _ in range(100):
+            if worker.num_recoveries >= 1 and stream.num_resyncs >= 1:
+                break
+            await asyncio.sleep(0.1)
+        assert worker.num_recoveries >= 1 and stream.num_resyncs >= 1
+        # convergence: the view matches the live store exactly
+        for _ in range(100):
+            diff = await stream.reconcile()
+            if diff == {"missing": [], "extra": [], "changed": []}:
+                break
+            await asyncio.sleep(0.1)
+        assert diff == {"missing": [], "extra": [], "changed": []}
+        assert stream.state == {"rw/leased": b"claim", "rw/durable": b"kept"}
+        # stale-while-revalidate: the re-asserted leased key never flapped
+        assert not [e for e in events if e["event"] == "delete"]
+        consumer.cancel()
+        await stream.cancel()
+        await worker.close()
+        await watcher.close()
+        await s2.stop()
+    except BaseException:
+        for obj in (worker, watcher):
+            try:
+                await obj.close()
+            except Exception:
+                pass
+        raise

@@ -9,6 +9,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import FnEngine
 from dynamo_tpu.runtime.transport import (
     ERR_APP,
+    ERR_DRAINING,
     ERR_OVERLOADED,
     ERR_UNAVAILABLE,
     EngineError,
@@ -172,4 +173,6 @@ async def test_draining_rejects_new_requests(served):
     with pytest.raises(EngineError) as exc_info:
         async for _ in client.generate(addr, {"n": 1, "msg": "x"}, Context()):
             pass
-    assert exc_info.value.code == ERR_UNAVAILABLE
+    # draining is its own retryable code: routers divert instead of
+    # counting it against the worker's circuit breaker
+    assert exc_info.value.code == ERR_DRAINING
