@@ -30,7 +30,10 @@ BENCH_MODEL (tiny|1b), BENCH_PROBE_TIMEOUT (default 600), BENCH_TIMEOUT
 BENCH_DECODE_STEPS (autopilot window length; default 1 on TPU — in-program
 step chains defeat XLA cache aliasing), BENCH_PIPELINE_DEPTH (run-ahead
 windows in flight; default 16 on TPU), BENCH_BLOCK_LOOKAHEAD (blocks
-reserved ahead per seq; default 8 on TPU).
+reserved ahead per seq; default 8 on TPU), BENCH_SPEC_MODE (off|ngram —
+speculative decoding; default off), BENCH_SPEC_K (draft tokens per verify
+window; default 4), BENCH_ATTENTION_IMPL (pallas|einsum|auto; "auto" probes
+both decode-attention paths at startup and reports the choice + ratio).
 """
 
 from __future__ import annotations
@@ -217,6 +220,11 @@ async def run_bench() -> dict:
         "BENCH_PIPELINE_DEPTH", 16 if on_tpu else 2))
     lookahead = int(os.environ.get(
         "BENCH_BLOCK_LOOKAHEAD", 8 if on_tpu else 0))
+    spec_mode = os.environ.get("BENCH_SPEC_MODE", "off")
+    spec_k = int(os.environ.get("BENCH_SPEC_K", 4))
+    attn_impl = os.environ.get("BENCH_ATTENTION_IMPL", "auto")
+    spec_kw = dict(spec_mode=spec_mode, spec_k=spec_k,
+                   attention_impl=attn_impl)
     if model_name == "tiny":
         model_cfg = ModelConfig.tiny()
         defaults = (64, 16, 8, 24)
@@ -225,7 +233,7 @@ async def run_bench() -> dict:
             max_num_batched_tokens=256,
             prefill_buckets=(256,), decode_buckets=(16,), max_num_seqs=16,
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
-            block_lookahead=lookahead,
+            block_lookahead=lookahead, **spec_kw,
         )
     elif baseline_profile:
         factory = {"1b": ModelConfig.llama3_1b,
@@ -250,7 +258,7 @@ async def run_bench() -> dict:
             prefill_buckets=(512, 1024), decode_buckets=(64,),
             max_num_seqs=64,
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
-            block_lookahead=lookahead,
+            block_lookahead=lookahead, **spec_kw,
         )
     isl = int(os.environ.get("BENCH_ISL", defaults[0]))
     osl = int(os.environ.get("BENCH_OSL", defaults[1]))
@@ -283,7 +291,7 @@ async def run_bench() -> dict:
             mesh_shape=tuple(int(x) for x in os.environ.get(
                 "BENCH_MESH", "1,1").split(",")),
             decode_steps=decode_steps, pipeline_depth=pipe_depth,
-            block_lookahead=lookahead,
+            block_lookahead=lookahead, **spec_kw,
         )
 
     engine = InferenceEngine(model_cfg, eng_cfg)
@@ -326,6 +334,7 @@ async def run_bench() -> dict:
     ttfts.clear()
     itls.clear()
     done_tokens[0] = 0
+    engine.num_fetch_syncs = 0  # count only measured-loop host syncs
 
     sem = asyncio.Semaphore(concurrency)
 
@@ -379,7 +388,21 @@ async def run_bench() -> dict:
         "num_delta_rows": getattr(engine, "num_delta_rows", 0),
         "num_cols_uploads": getattr(engine, "num_cols_uploads", 0),
         "num_prefills": getattr(engine, "num_prefill_dispatches", 0),
+        # host-sync efficiency: output tokens landed per device->host
+        # result fetch; speculative decoding's whole point on the ~64 ms
+        # remote-PJRT channel is pushing this above 1.0
+        "num_fetch_syncs": getattr(engine, "num_fetch_syncs", 0),
+        "tokens_per_host_sync": round(
+            done_tokens[0] / max(1, getattr(engine, "num_fetch_syncs", 0)),
+            3),
+        "spec_mode": spec_mode,
+        "spec_acceptance_rate": round(
+            engine.spec_stats.acceptance_rate
+            if getattr(engine, "spec_stats", None) is not None else 0.0,
+            4),
     }
+    if getattr(engine, "attention_impl_choice", None) is not None:
+        result["attention_impl_choice"] = engine.attention_impl_choice
     if on_tpu:
         try:
             result.update(_kernel_check())

@@ -1,0 +1,113 @@
+"""Startup kernel auto-selection for ``attention_impl="auto"``.
+
+BENCH_r05 measured the Pallas paged-attention decode kernel *losing* to the
+XLA gathered-einsum path on real hardware (kernel_speedup 0.91) — which
+path wins depends on generation/shape, so "auto" times both on the live
+backend at engine startup and picks the winner. The probe is one small
+decode-shaped attention call per impl (~tens of ms), not a model forward.
+
+On non-TPU backends the choice is einsum without probing: Pallas only runs
+in interpret mode there, which is orders of magnitude slower and would both
+waste startup time and always lose anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .config import EngineConfig, ModelConfig
+
+log = get_logger("autotune")
+
+
+def _time_attention(fn, args, iters: int = 20) -> float:
+    fn(*args).block_until_ready()  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def probe_attention_impl(
+    model_config: ModelConfig, engine_config: EngineConfig,
+) -> Tuple[EngineConfig, dict]:
+    """Resolve ``attention_impl="auto"`` → a concrete impl.
+
+    Returns (engine_config with the winner substituted, choice-info dict
+    with the measured per-call times and ratio). Anything going wrong in
+    the probe falls back to einsum — the always-correct reference path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.paged_attention import paged_attention_decode
+    from . import model as model_lib
+
+    if engine_config.attention_impl != "auto":
+        return engine_config, {
+            "impl": engine_config.attention_impl, "probed": False,
+        }
+
+    choice: dict = {"probed": False}
+    if jax.default_backend() != "tpu":
+        # interpret-mode Pallas is not a contender; don't burn startup time
+        choice.update(impl="einsum", reason="non-tpu backend")
+    else:
+        try:
+            bs = engine_config.block_size
+            B = min(16, max(engine_config.decode_buckets))
+            W = max(2, min(8, engine_config.max_blocks_per_seq))
+            KV = model_config.num_kv_heads
+            H = model_config.num_heads
+            hd = model_config.head_dim_
+            NB = 1 + B * W
+            rng = np.random.default_rng(0)
+            dt = jnp.bfloat16 if model_config.dtype == "bfloat16" \
+                else jnp.float32
+            q = jnp.asarray(rng.standard_normal((B, H, hd)), dt)
+            k = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
+            v = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
+            tables = jnp.asarray(
+                1 + np.arange(B * W).reshape(B, W), jnp.int32)
+            lens = jnp.full((B,), W * bs, jnp.int32)
+
+            kernel = jax.jit(functools.partial(
+                paged_attention_decode, block_size=bs))
+
+            @jax.jit
+            def einsum_path(q, kc, vc, tables, lens):
+                k_all = jnp.take(kc, tables.reshape(-1), axis=0).reshape(
+                    B, W, KV, bs, hd
+                ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
+                v_all = jnp.take(vc, tables.reshape(-1), axis=0).reshape(
+                    B, W, KV, bs, hd
+                ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
+                pos = (lens - 1)[:, None]
+                return model_lib._attention(q[:, None], k_all, v_all,
+                                            pos)[:, 0]
+
+            args = (q, k, v, tables, lens)
+            pallas_ms = _time_attention(kernel, args)
+            einsum_ms = _time_attention(einsum_path, args)
+            impl = "pallas" if pallas_ms < einsum_ms else "einsum"
+            choice.update(
+                impl=impl, probed=True,
+                pallas_ms=round(pallas_ms, 4),
+                einsum_ms=round(einsum_ms, 4),
+                # >1 means the Pallas kernel is faster
+                ratio=round(einsum_ms / max(pallas_ms, 1e-9), 3),
+            )
+        except Exception as e:
+            choice.update(impl="einsum",
+                          reason=f"probe failed: {type(e).__name__}: {e}")
+    log.info("attention_impl=auto resolved: %s", choice)
+    resolved = dataclasses.replace(
+        engine_config, attention_impl=choice["impl"])
+    return resolved, choice

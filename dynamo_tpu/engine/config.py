@@ -143,12 +143,39 @@ class EngineConfig:
     # activation memory is O(T / n_devices) and BASELINE's 8k-ISL shapes
     # don't have to fit one chip's budget. 0 = disabled (chunked prefill).
     sp_prefill_threshold: int = 0
+    # speculative decoding: "ngram" replaces each decode window with a
+    # draft+verify window — a device-resident prompt-lookup drafter proposes
+    # up to spec_k continuation tokens from the seq's own on-device token
+    # history and ONE ragged [B, k+1] forward verifies them, so a single
+    # host round-trip can land up to k+1 tokens. Greedy rows get exact
+    # parity with spec_mode="off"; sampled rows emit 1 token per window.
+    spec_mode: str = "off"              # "off" | "ngram"
+    spec_k: int = 4                     # max draft tokens per window
+    spec_ngram_min: int = 1             # smallest suffix n-gram to match
+    spec_ngram_max: int = 3             # largest suffix n-gram to match
+    # adaptive kill switch: once spec_auto_disable_window draft tokens have
+    # been verified, an acceptance rate below the threshold permanently
+    # falls back to plain autopilot windows (0.0 = never disable)
+    spec_auto_disable_threshold: float = 0.0
+    spec_auto_disable_window: int = 256
+    # device token-history capacity per seat (0 = max_model_len); drafting
+    # only sees the first spec_hist_cap positions of each sequence
+    spec_hist_cap: int = 0
 
     def __post_init__(self):
         if self.pp_stages > 1 and self.mesh_shape != (1, 1):
             raise ValueError("pp_stages and a (dp, tp) mesh are exclusive")
         if self.max_num_seqs > max(self.decode_buckets):
             raise ValueError("max_num_seqs exceeds largest decode bucket")
+        if self.spec_mode not in ("off", "ngram"):
+            raise ValueError(f"unknown spec_mode {self.spec_mode!r}")
+        if self.spec_mode != "off":
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if not (1 <= self.spec_ngram_min <= self.spec_ngram_max):
+                raise ValueError("need 1 <= spec_ngram_min <= spec_ngram_max")
+            if self.pp_stages > 1:
+                raise ValueError("spec_mode requires pp_stages == 1")
         # max_num_batched_tokens MAY exceed the largest prefill bucket:
         # the scheduler caps each chunk at the bucket, so extra budget
         # just lets decode seats coexist with a full-bucket prefill

@@ -61,17 +61,23 @@ class Request:
 
 
 class _BatchingFetcher:
-    """One thread draining a queue of (batch, handles, future): ONE
-    ``jax.device_get`` per group of accumulated windows. On remote-PJRT
-    every get is a ~64 ms+ channel sync, so per-window fetching caps the
-    pipeline at ~15 windows/s; grouped fetching pays one sync for the
-    whole backlog and the dispatch side never waits."""
+    """One thread draining a queue of (batch, handles, future), one
+    ``jax.device_get`` per WINDOW, with the D2H copy started
+    asynchronously at submit time. On remote-PJRT every cold get is a
+    ~64 ms+ channel sync; ``copy_to_host_async`` at dispatch overlaps the
+    transfer with compute, so by the time the fetch thread reaches a
+    window its bytes are (usually) already host-side and the get is
+    cheap. Fetching per window — instead of grouping the whole backlog
+    into one get — is what keeps inter-token latency real: each window's
+    tokens flush to the SSE streams as that window lands, not in one
+    burst when the backlog drains."""
 
-    def __init__(self, unpack):
+    def __init__(self, unpack, on_sync=None):
         import queue as _queue
 
         self._q: Any = _queue.Queue()
         self._unpack = unpack
+        self._on_sync = on_sync   # () -> None, counts host syncs
         self._thread = None
 
     def ensure_started(self) -> None:
@@ -85,6 +91,14 @@ class _BatchingFetcher:
 
     def submit(self, loop, batch, handles):
         fut = loop.create_future()
+        # kick off the device→host transfer now, while the next window
+        # computes; the fetch thread's device_get then mostly finds the
+        # bytes already resident
+        for arr in self._flat(handles):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # best-effort (some backends/arrays don't support it)
         self._q.put((loop, batch, handles, fut))
         return fut
 
@@ -92,54 +106,31 @@ class _BatchingFetcher:
         if self._thread is not None:
             self._q.put(None)
 
-    def _run(self) -> None:
-        import queue as _queue
+    @staticmethod
+    def _flat(handles) -> List[Any]:
+        ph, dh = handles
+        return list(ph) + ([dh[0]] if dh is not None else [])
 
+    def _run(self) -> None:
         while True:
             item = self._q.get()
             if item is None:
                 return
-            group = [item]
-            stop = False
-            while True:
-                try:
-                    nxt = self._q.get_nowait()
-                except _queue.Empty:
-                    break
-                if nxt is None:
-                    stop = True
-                    break
-                group.append(nxt)
-            flat: List[Any] = []
-            spans = []
-            for (_, batch, handles, _f) in group:
-                ph, dh = handles
-                n0 = len(flat)
-                flat.extend(ph)
-                if dh is not None:
-                    flat.append(dh[0])
-                spans.append((n0, len(flat)))
+            loop, batch, handles, fut = item
+            flat = self._flat(handles)
             try:
                 got = jax.device_get(flat) if flat else []
-                err = None
+                if flat and self._on_sync is not None:
+                    self._on_sync()
+                res, exc = self._unpack(batch, handles, got), None
             except Exception as e:  # donated-buffer poison, backend death
-                got, err = [], e
-            for (loop, batch, handles, fut), (a, b) in zip(group, spans):
-                if err is not None:
-                    res, exc = None, err
-                else:
-                    try:
-                        res, exc = self._unpack(batch, handles, got[a:b]), None
-                    except Exception as e:
-                        res, exc = None, e
-                try:
-                    loop.call_soon_threadsafe(_fut_set, fut, res, exc)
-                except RuntimeError:
-                    # the loop closed under us (engine torn down mid-flight);
-                    # keep draining so the remaining futures get resolved
-                    pass
-            if stop:
-                return
+                res, exc = None, e
+            try:
+                loop.call_soon_threadsafe(_fut_set, fut, res, exc)
+            except RuntimeError:
+                # the loop closed under us (engine torn down mid-flight);
+                # keep draining so the remaining futures get resolved
+                pass
 
 
 def _fut_set(fut, res, exc) -> None:
@@ -221,6 +212,12 @@ class EngineCore(AsyncEngine):
         # counters
         self.num_generated_tokens = 0
         self.num_steps = 0
+        # host syncs (device_get round-trips) — with speculative decoding
+        # the headline efficiency metric is tokens landed per sync
+        self.num_fetch_syncs = 0
+        # SpecDecodeStats when spec decode is active (InferenceEngine sets
+        # it); published worker → aggregator and stamped on decode spans
+        self.spec_stats = None
 
     # ------------------------- lifecycle -------------------------------
 
@@ -527,6 +524,9 @@ class EngineCore(AsyncEngine):
                           start_mono=t_sched, end_mono=(t_first or end))
         if t_first is not None:
             attrs = {"num_tokens": len(seq.output_ids)}
+            if getattr(self, "spec_stats", None) is not None:
+                attrs["spec_drafted"] = seq.spec_drafted
+                attrs["spec_accepted"] = seq.spec_accepted
             tracer.record("engine.decode", context, start_mono=t_first,
                           end_mono=end, attrs=attrs)
 
@@ -809,6 +809,14 @@ class InferenceEngine(EngineCore):
         seed: int = 0,
         devices: Optional[list] = None,
     ):
+        # attention_impl="auto": time Pallas vs einsum on the live backend
+        # and bake the winner into the config BEFORE any step fn is built
+        self.attention_impl_choice: Optional[dict] = None
+        if engine_config.attention_impl == "auto":
+            from .autotune import probe_attention_impl
+            engine_config, self.attention_impl_choice = (
+                probe_attention_impl(model_config, engine_config)
+            )
         super().__init__(engine_config)
         self.model_config = model_config
         self.pp = engine_config.pp_stages
@@ -865,12 +873,36 @@ class InferenceEngine(EngineCore):
                     self._ap_Wcap, self.mesh,
                 )
             )
+            # speculative decoding: drafter history + draft/verify window
+            self._spec_k = 0
+            self._spec_hist_cap = 0
+            self._spec_auto_disabled = False
+            if engine_config.spec_mode == "ngram":
+                self._spec_k = engine_config.spec_k
+                self._spec_hist_cap = (engine_config.spec_hist_cap
+                                       or engine_config.max_model_len)
+                self._spec_window_fn, self._spec_hist_fill_fn = (
+                    model_lib.make_spec_fns(
+                        model_config, engine_config, self._spec_k,
+                        engine_config.spec_ngram_min,
+                        engine_config.spec_ngram_max, self.mesh,
+                    )
+                )
+                from ..spec.stats import SpecDecodeStats
+                self.spec_stats = SpecDecodeStats()
+                # a spec window lands a DATA-DEPENDENT 1..k+1 tokens, so
+                # run-ahead scheduling (which predicts the next window's
+                # base) is off the table: force the synchronous loop
+                if engine_config.pipeline_depth > 1:
+                    log.info("spec_mode=ngram forces pipeline_depth=1")
+                self.scheduler.spec_plan_window = self._spec_k + 1
             from jax.sharding import NamedSharding, PartitionSpec
             repl = NamedSharding(self.mesh, PartitionSpec())
             self._ctl = jax.device_put(
                 model_lib.init_ctl(
                     engine_config, engine_config.max_num_seqs,
                     self._ap_Wcap, seed=seed + 2,
+                    hist_cap=self._spec_hist_cap,
                 ),
                 repl,
             )
@@ -887,6 +919,8 @@ class InferenceEngine(EngineCore):
             self._ap_rows_dev = None            # its device array
             self._ap_dead: set = set()          # slots to kill next dispatch
             self.pipeline_depth = max(1, engine_config.pipeline_depth)
+            if engine_config.spec_mode == "ngram":
+                self.pipeline_depth = 1
             if (engine_config.sp_prefill_threshold > 0
                     and self.mesh.devices.size > 1):
                 self._sp_prefill_fn = model_lib.make_sp_ring_prefill_fn(
@@ -904,7 +938,9 @@ class InferenceEngine(EngineCore):
         # (~64 ms+ on remote-PJRT) and must never delay the next window's
         # enqueue; grouped gets keep the landing rate above the K=1
         # window rate.
-        self._fetcher = _BatchingFetcher(self._unpack_results)
+        self._fetcher = _BatchingFetcher(
+            self._unpack_results, on_sync=self._count_fetch_sync
+        )
         # multi-host: the leader's broadcaster observes every executed step
         # so followers can replay the identical jitted call sequence
         # (parallel/multihost.py); called on the executor thread
@@ -1120,6 +1156,9 @@ class InferenceEngine(EngineCore):
             self._ap.pop(slot, None)
         self._ap_apply_deltas(deltas)
 
+    def _count_fetch_sync(self) -> None:
+        self.num_fetch_syncs += 1
+
     def _fetch_results(self, batch, handles):
         """Fetch thread: device_get the window's sampled tokens (the only
         host↔device sync in the serving loop) and unpack per seat."""
@@ -1128,6 +1167,8 @@ class InferenceEngine(EngineCore):
         if decode_handle is not None:
             to_get.append(decode_handle[0])
         got = jax.device_get(to_get) if to_get else []
+        if to_get:
+            self.num_fetch_syncs += 1
         return self._unpack_results(batch, handles, got)
 
     def _unpack_results(self, batch, handles, got):
@@ -1143,13 +1184,55 @@ class InferenceEngine(EngineCore):
             col_of = {}
             for col, slot in enumerate(decode_handle[1]):
                 col_of.setdefault(slot, col)
-            out = np.asarray(got[-1])  # [K, B]
-            for row in batch.decode_rows:
-                col = col_of[row.slot]
-                decode_samples.append(
-                    [int(out[k, col]) for k in range(row.accepted)]
-                )
+            out = np.asarray(got[-1])  # [K, B] (spec: [k+3, B] packed)
+            if len(decode_handle) > 2 and decode_handle[2]:
+                decode_samples = self._unpack_spec(batch, out, col_of)
+            else:
+                for row in batch.decode_rows:
+                    col = col_of[row.slot]
+                    decode_samples.append([
+                        int(out[k, col])
+                        for k in range(min(row.accepted, out.shape[0]))
+                    ])
         return prefill_samples, decode_samples
+
+    def _unpack_spec(self, batch, out, col_of) -> List[List[int]]:
+        """Spec verify window landing: packed rows 0..k are emitted token
+        candidates, row k+1 n_emitted, row k+2 n_drafted. Runs on the
+        (single) executor thread — spec forces the synchronous loop — so
+        correcting the host mirror's pessimistic pos here is ordered
+        strictly before the next dispatch."""
+        kk = self._spec_k
+        stats = self.spec_stats
+        decode_samples: List[List[int]] = []
+        for row in batch.decode_rows:
+            col = col_of[row.slot]
+            n = int(out[kk + 1, col])
+            ndraft = int(out[kk + 2, col])
+            n_use = min(n, row.accepted)
+            decode_samples.append([int(out[j, col]) for j in range(n_use)])
+            row.seq.spec_drafted += ndraft
+            row.seq.spec_accepted += max(n - 1, 0)
+            stats.drafted += ndraft
+            stats.accepted += max(n - 1, 0)
+            stats.emitted += n_use
+            st = self._ap.get(row.slot)
+            if st is not None and st["seq_id"] == row.seq.seq_id:
+                st["pos"] = row.base + n
+        stats.windows += 1
+        th = self.config.spec_auto_disable_threshold
+        if (th > 0.0 and not self._spec_auto_disabled
+                and stats.drafted >= self.config.spec_auto_disable_window
+                and stats.acceptance_rate < th):
+            # one-way: drafting is costing more verify compute than it
+            # saves in syncs on this workload; fall back to plain windows
+            self._spec_auto_disabled = True
+            self.scheduler.spec_plan_window = None
+            log.info(
+                "spec decode auto-disabled: acceptance %.3f < %.3f after "
+                "%d drafts", stats.acceptance_rate, th, stats.drafted,
+            )
+        return decode_samples
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -1324,12 +1407,18 @@ class InferenceEngine(EngineCore):
         no growth) dispatches with ZERO fresh host arrays — all control
         state is device-resident; the host sends packed deltas only on
         joins, block growth, resumes, and seat-map changes. Returns
-        (samples_handle [K, B], col_map) where col_map[device column] is
-        the slot computed there."""
+        (samples_handle [K, B], col_map, spec) where col_map[device
+        column] is the slot computed there and ``spec`` marks a packed
+        spec-window handle."""
         cfg = self.config
         bs = cfg.block_size
-        K = self._window_K
+        spec = self._spec_active()
+        # spec windows land a data-dependent 1..k+1 tokens; mirror the
+        # device's advance pessimistically here (max) and correct it in
+        # _unpack_spec before the next dispatch (synchronous loop)
+        K = (self._spec_k + 1) if spec else self._window_K
         deltas: Dict[int, Dict[str, Any]] = {}
+        reset_rows: List[Any] = []
         for r in rows:
             s = r.seq
             vu = min(len(s.block_table) * bs, cfg.max_model_len)
@@ -1348,6 +1437,7 @@ class InferenceEngine(EngineCore):
                     "table": s.block_table, "temp": s.temperature,
                     "tp": s.top_p,
                 }
+                reset_rows.append(r)
             elif st["vu"] != vu or st["tlen"] != tlen:
                 deltas[r.slot] = {
                     "pos": r.base, "vu": vu, "tk": s.top_k,
@@ -1363,6 +1453,8 @@ class InferenceEngine(EngineCore):
             }
         if deltas:
             self._ap_apply_deltas(deltas)
+            if spec and reset_rows:
+                self._spec_fill_hist(reset_rows)
         # seat map: reuse the device map only when the LIVE seats it holds
         # are exactly the scheduled set. Dead seats idle at vu=0, but a
         # LIVE slot the scheduler skipped this round (pool pressure) must
@@ -1383,12 +1475,35 @@ class InferenceEngine(EngineCore):
             self.num_cols_uploads += 1
             self._ap_rows_dev = jax.device_put(arr)
         if self.step_sink is not None:
-            self.step_sink("w", {})
+            self.step_sink("sw" if spec else "w", {})
         self.num_windows += 1
-        self.cache, self._ctl, samples = self._ap_window_fn(
+        fn = self._spec_window_fn if spec else self._ap_window_fn
+        self.cache, self._ctl, samples = fn(
             self.params, self.cache, self._ctl, self._ap_rows_dev,
         )
-        return samples, list(self._ap_cols)
+        return samples, list(self._ap_cols), spec
+
+    def _spec_active(self) -> bool:
+        return self._spec_k > 0 and not self._spec_auto_disabled
+
+    def _spec_fill_hist(self, rows) -> None:
+        """Inject full token histories for joining/reset seats so the
+        on-device drafter has context immediately — including resumed and
+        migrated sequences, whose carried tokens arrive with the request.
+        One [n, Hcap+1] upload per join delta; steady-state windows extend
+        the history on device with no uploads at all."""
+        Hcap = self._spec_hist_cap
+        trash = self.config.max_num_seqs
+        n = _pow2_bucket(len(rows))
+        slots = np.full((n,), trash, np.int32)
+        hrows = np.full((n, Hcap + 1), -1, np.int32)
+        for i, r in enumerate(rows):
+            toks = r.seq.all_tokens()[:min(r.base + 1, Hcap)]
+            slots[i] = r.slot
+            hrows[i, :len(toks)] = toks
+        if self.step_sink is not None:
+            self.step_sink("sph", {"slots": slots, "hist": hrows})
+        self._ctl = self._spec_hist_fill_fn(self._ctl, slots, hrows)
 
     # ---- legacy synchronous path (pipeline-parallel engines only) ----
 

@@ -793,10 +793,17 @@ def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
 CTL_I32_FIELDS = 6  # slot, pos, valid_until, top_k, seed, last_tok
 
 
-def init_ctl(eng: EngineConfig, S: int, Wcap: int, seed: int = 0):
+def init_ctl(eng: EngineConfig, S: int, Wcap: int, seed: int = 0,
+             hist_cap: int = 0):
     """Fresh device control state (host-side construction; device_put by
-    the caller with a replicated sharding)."""
-    return {
+    the caller with a replicated sharding).
+
+    ``hist_cap > 0`` (spec decode) adds a per-seat token history ``hist``
+    [S+1, hist_cap+1] for the n-gram drafter: ``hist[s, p]`` is sequence
+    s's token at position p, -1 = unknown; column hist_cap is a trash
+    column for padded scatters. The autopilot window/delta fns pass the
+    extra key through untouched."""
+    ctl = {
         "pos": np.zeros((S + 1,), np.int32),
         "vu": np.zeros((S + 1,), np.int32),
         "temp": np.zeros((S + 1,), np.float32),
@@ -808,6 +815,9 @@ def init_ctl(eng: EngineConfig, S: int, Wcap: int, seed: int = 0):
         "key": jax.random.PRNGKey(seed),
         "ctr": np.zeros((), np.int32),
     }
+    if hist_cap > 0:
+        ctl["hist"] = np.full((S + 1, hist_cap + 1), -1, np.int32)
+    return ctl
 
 
 def raw_ctl_delta_fn(Wcap: int):
@@ -898,6 +908,142 @@ def make_autopilot_fns(cfg: ModelConfig, eng: EngineConfig, K: int,
     )
     delta = jax.jit(raw_ctl_delta_fn(Wcap), donate_argnums=(0,))
     return window, delta
+
+
+# ------------------- speculative decode window (draft + verify) -----------
+#
+# One autopilot window lands at most K tokens per host sync. The spec
+# window raises the per-sync yield without a draft model: an on-device
+# prompt-lookup drafter (spec/ngram.py) proposes up to k continuation
+# tokens from the seat's own token history, and ONE [B, k+1] ragged
+# forward verifies the chain against the paged cache — accepted prefix +
+# one bonus/corrective token land per sync, up to k+1 total. Greedy rows
+# are exactly parity-safe: every emitted token is the target model's own
+# argmax given a correct prefix. Draft tokens that get rejected DO write
+# KV at positions past the accepted point, but those positions are (a)
+# never attendable by any accepted query (the causal mask is
+# ``kpos <= q``), and (b) always re-scattered by the next window before
+# any later query reads them — so rejected tokens never poison the cache.
+
+
+def raw_spec_window_fn(cfg: ModelConfig, eng: EngineConfig, k: int,
+                       ngram_min: int, ngram_max: int,
+                       mesh: Optional[Mesh] = None):
+    """Draft + batched-verify decode window.
+
+    Signature: window(params, cache, ctl, slot_rows[B]) ->
+    (cache, ctl, packed[k+3, B]) where packed rows 0..k are the emitted
+    token candidates, row k+1 is n_emitted per seat (how many of them are
+    real), and row k+2 is n_drafted (accounting).
+
+    Drafting is restricted to greedy seats (temp <= 0); sampled seats run
+    the window as a plain single-token decode step, keyed by position for
+    seeded rows exactly like the non-spec path. Dead seats (vu <= pos)
+    feed trash and emit 0 tokens.
+    """
+    from ..spec.ngram import propose_drafts
+
+    def window(params, cache, ctl, slot_rows):
+        rows = slot_rows                                   # [B]
+        tok0 = ctl["last_tok"][rows]
+        pos0 = ctl["pos"][rows]
+        vu = ctl["vu"][rows]
+        temp = ctl["temp"][rows]
+        tk = ctl["tk"][rows]
+        tp = ctl["tp"][rows]
+        sd = ctl["seed"][rows]
+        tables = ctl["tables"][rows]
+        hist = ctl["hist"]                                 # [S+1, Hcap+1]
+        S = ctl["last_tok"].shape[0] - 1
+        Hcap = hist.shape[1] - 1
+        live = vu > pos0
+        # keep the history coherent with the ring: the window's input token
+        # IS all_tokens[pos0] (defensive — joins already host-fill it)
+        hist = hist.at[
+            jnp.where(live, rows, S),
+            jnp.where(live, jnp.clip(pos0, 0, Hcap - 1), Hcap),
+        ].set(tok0)
+        drafts = propose_drafts(hist[rows], pos0, k, ngram_min, ngram_max)
+        drafts = jnp.where((temp <= 0.0)[:, None], drafts, -1)  # [B, k]
+        dvalid = jnp.cumprod(
+            (drafts >= 0).astype(jnp.int32), axis=1
+        ).astype(bool)
+        steps = jnp.arange(k + 1, dtype=jnp.int32)
+        toks = jnp.concatenate(
+            [tok0[:, None], jnp.where(dvalid, drafts, 0)], axis=1
+        )                                                  # [B, k+1]
+        pos = pos0[:, None] + steps[None, :]
+        feed = jnp.concatenate(
+            [jnp.ones_like(dvalid[:, :1]), dvalid], axis=1
+        ) & (pos < vu[:, None])
+        pos_eff = jnp.where(feed, pos, -1)
+        cache, h = forward(
+            cfg, eng, params, cache, toks, pos_eff, tables, mesh=mesh,
+        )
+        logits = logits_fn(cfg, params, h)                 # [B, k+1, V]
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        rng_w = jax.random.fold_in(ctl["key"], ctl["ctr"])
+        s0 = sample(logits[:, 0], rng_w, temp, tk, tp, sd, pos0)
+        emitted = jnp.concatenate([s0[:, None], g[:, 1:]], axis=1)
+        # accept the longest draft prefix the target model reproduces; the
+        # query at index i (position pos0+i) verifies draft i
+        match = dvalid & (drafts == g[:, :k])              # [B, k]
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        cap = jnp.clip(vu - pos0, 0, k + 1)
+        n = jnp.minimum(a + 1, cap)                        # [B] emitted
+        final = jnp.take_along_axis(
+            emitted, jnp.maximum(n - 1, 0)[:, None], axis=1
+        )[:, 0]
+        ctl = dict(ctl)
+        write_rows = jnp.where(n > 0, rows, S)
+        ctl["last_tok"] = ctl["last_tok"].at[write_rows].set(final)
+        # duplicate trash rows accumulate zero (n there is 0)
+        ctl["pos"] = ctl["pos"].at[rows].add(n)
+        # append the landed tokens to the history (emitted j is
+        # all_tokens[pos0+1+j]); rejects route to the trash cell
+        hv = steps[None, :] < n[:, None]
+        ctl["hist"] = hist.at[
+            jnp.where(hv, rows[:, None], S),
+            jnp.where(hv, jnp.clip(pos0[:, None] + 1 + steps[None, :],
+                                   0, Hcap - 1), Hcap),
+        ].set(emitted)
+        ctl["ctr"] = ctl["ctr"] + 1
+        ndraft = jnp.sum(dvalid.astype(jnp.int32), axis=1)
+        packed = jnp.concatenate(
+            [emitted.T, n[None, :], ndraft[None, :]], axis=0
+        ).astype(jnp.int32)                                # [k+3, B]
+        return cache, ctl, packed
+
+    return window
+
+
+def raw_spec_hist_fill_fn():
+    """Host-side history injection for joining/resumed seats.
+
+    fill(ctl, slots[n], rows[n, Hcap+1]) scatters full token-history rows
+    (-1-padded) into ``ctl["hist"]``. Pad entries use slot = S (trash).
+    Dispatched only on seat joins/resets — steady-state spec windows
+    maintain the history on device with zero host uploads.
+    """
+
+    def fill(ctl, slots, rows):
+        ctl = dict(ctl)
+        ctl["hist"] = ctl["hist"].at[slots].set(rows)
+        return ctl
+
+    return fill
+
+
+def make_spec_fns(cfg: ModelConfig, eng: EngineConfig, k: int,
+                  ngram_min: int, ngram_max: int,
+                  mesh: Optional[Mesh] = None):
+    """(spec_window_fn, hist_fill_fn) jitted with cache/ctl donated."""
+    window = jax.jit(
+        raw_spec_window_fn(cfg, eng, k, ngram_min, ngram_max, mesh),
+        donate_argnums=(1, 2),
+    )
+    fill = jax.jit(raw_spec_hist_fill_fn(), donate_argnums=(0,))
+    return window, fill
 
 
 def raw_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,

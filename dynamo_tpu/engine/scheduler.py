@@ -231,6 +231,10 @@ class SchedSeq:
     pending_prompt: int = 0   # prefill chunk tokens in flight
     pending_first: int = 0    # 1 while the prompt-completing sample is in flight
     pending_decode: int = 0   # decode tokens in flight
+    # speculative decoding accounting (engine-updated; surfaces as
+    # engine.decode span attributes)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def pending_total(self) -> int:
@@ -333,6 +337,11 @@ class Scheduler:
         # emitting a whole-prompt chunk the engine must run densely would
         # bypass max_num_batched_tokens entirely
         self.sp_enabled = False
+        # speculative decoding: when set (spec_k + 1), decode windows are
+        # planned this many tokens wide instead of decode_steps — the spec
+        # window may land anywhere from 1 to spec_k+1 of them; the engine
+        # clears it again on adaptive auto-disable
+        self.spec_plan_window: Optional[int] = None
 
     # -- admission --
 
@@ -361,7 +370,7 @@ class Scheduler:
         # Scheduling reads *through* in-flight work (pending_*): a window
         # can be planned before the previous one lands, with the input
         # token fed from the device ring (run-ahead pipelining).
-        window = max(1, self.config.decode_steps)
+        window = self.spec_plan_window or max(1, self.config.decode_steps)
         if self.config.block_lookahead:
             # SYNCHRONISED lookahead: when any running seq's runway drops
             # below half the lookahead, top up EVERY running seq to the

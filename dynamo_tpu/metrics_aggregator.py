@@ -59,6 +59,14 @@ class MetricsAggregator:
         self._g_hit_rate = m.gauge(
             "prefix_cache_hit_rate", "aggregate prefix cache hit rate"
         )
+        self._g_spec_accept = m.gauge(
+            "worker_spec_acceptance_rate",
+            "per-worker speculative-draft acceptance rate", ["worker"]
+        )
+        self._g_spec_rate = m.gauge(
+            "spec_acceptance_rate",
+            "aggregate speculative-draft acceptance rate"
+        )
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
@@ -113,8 +121,15 @@ class MetricsAggregator:
             snap.get("num_requests_running", 0))
         self._g_waiting.labels(worker=wid).set(
             snap.get("num_requests_waiting", 0))
+        # forward-compat: pre-spec workers publish no "spec" field — treat
+        # it as all-zero stats rather than choking on the absent key
+        spec = snap.get("spec") or {}
+        drafted = spec.get("drafted", 0)
+        self._g_spec_accept.labels(worker=wid).set(
+            spec.get("accepted", 0) / drafted if drafted else 0.0)
         self.expire_stale()
         self._recompute_hit_rate()
+        self._recompute_spec_rate()
 
     def expire_stale(self) -> None:
         """Drop workers whose stats went silent past ``stale_after_s`` and
@@ -125,7 +140,8 @@ class MetricsAggregator:
         for wid in stale:
             self.worker_stats.pop(wid, None)
             self._last_seen.pop(wid, None)
-            for gauge in (self._g_usage, self._g_running, self._g_waiting):
+            for gauge in (self._g_usage, self._g_running, self._g_waiting,
+                          self._g_spec_accept):
                 gauge.remove(worker=wid)
             log.info("expired stale worker %s from the scrape", wid)
 
@@ -135,6 +151,13 @@ class MetricsAggregator:
         queries = sum(s.get("prefix_cache_queries", 0)
                       for s in self.worker_stats.values())
         self._g_hit_rate.set(hits / queries if queries else 0.0)
+
+    def _recompute_spec_rate(self) -> None:
+        drafted = sum((s.get("spec") or {}).get("drafted", 0)
+                      for s in self.worker_stats.values())
+        accepted = sum((s.get("spec") or {}).get("accepted", 0)
+                       for s in self.worker_stats.values())
+        self._g_spec_rate.set(accepted / drafted if drafted else 0.0)
 
     def _on_kv_event(self, payload: dict) -> None:
         kind = payload.get("event", {}).get("kind", "unknown")

@@ -245,6 +245,18 @@ def replay_plan(engine, kind: str, arrays: Dict[str, np.ndarray]) -> None:
             engine._ap_rows_dev,
         )
         return
+    if kind == "sw":
+        # spec draft+verify window: zero arrays, like "w"
+        engine.cache, engine._ctl, _ = engine._spec_window_fn(
+            engine.params, engine.cache, engine._ctl,
+            engine._ap_rows_dev,
+        )
+        return
+    if kind == "sph":
+        engine._ctl = engine._spec_hist_fill_fn(
+            engine._ctl, arrays["slots"], arrays["hist"]
+        )
+        return
     if kind == "ctl":
         engine._ctl = engine._ap_delta_fn(
             engine._ctl, arrays["di"], arrays["df"]

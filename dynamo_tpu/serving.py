@@ -63,8 +63,10 @@ async def serve_engine(
     kv_pub = KvEventPublisher(endpoint.component, runtime.primary_lease)
     kv_pub.start()
     engine.kv_event_sink = kv_pub.sink
+    st = getattr(engine, "spec_stats", None)
     metrics_pub = WorkerMetricsPublisher(
-        endpoint.component, runtime.primary_lease, lambda: engine.stats
+        endpoint.component, runtime.primary_lease, lambda: engine.stats,
+        spec_fn=st.to_dict if st is not None else None,
     )
     metrics_pub.start()
 

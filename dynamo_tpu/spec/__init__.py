@@ -1,0 +1,22 @@
+"""Speculative decoding: device-side n-gram drafting + batched verify.
+
+The subsystem has three pieces:
+
+- :mod:`.ngram` — the prompt-lookup drafter. A jitted n-gram match of the
+  last suffix of each sequence's on-device token history against that same
+  history; no draft model, no extra host uploads during steady-state decode.
+- ``raw_spec_window_fn`` in :mod:`..engine.model` — the batched verify
+  window: ONE target-model forward over ``[B, k+1]`` ragged query tokens
+  against the paged KV cache, accepting the longest matching prefix.
+- :mod:`.stats` — ``SpecDecodeStats`` accounting (drafted / accepted /
+  acceptance rate) published worker → metrics aggregator → planner.
+
+Greedy rows have exact parity with ``spec_mode="off"`` (a hard invariant —
+see ``tests/test_spec_decode.py``); sampled rows emit one token per window
+with the same position-keyed RNG as the non-spec path when seeded.
+"""
+
+from .ngram import propose_drafts, propose_drafts_reference
+from .stats import SpecDecodeStats
+
+__all__ = ["propose_drafts", "propose_drafts_reference", "SpecDecodeStats"]

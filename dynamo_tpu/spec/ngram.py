@@ -1,0 +1,103 @@
+"""Prompt-lookup drafting: n-gram suffix match over on-device token history.
+
+Each decode seat keeps a per-sequence token history row ``hist[0..pos0]``
+(``hist[p]`` = token at position ``p``; -1 marks unknown positions, e.g.
+prefix-cache gaps or unused tail). Drafting finds the most recent earlier
+occurrence of the current suffix n-gram — trying the largest n first — and
+proposes the k tokens that followed it. Proposals are *always* verified by
+the target model, so a bad match costs throughput, never correctness.
+
+``propose_drafts`` is the traced/jittable version used inside the spec
+window fn; ``propose_drafts_reference`` is a plain-numpy oracle for tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _propose_row(hist: jax.Array, pos0: jax.Array, k: int,
+                 ngram_min: int, ngram_max: int) -> jax.Array:
+    """Drafts for one history row.
+
+    hist: [H] int32 tokens (-1 = unknown), pos0: scalar index of the last
+    known token. Returns [k] int32 drafts, -1-padded; valid drafts form a
+    contiguous prefix.
+    """
+    H = hist.shape[0]
+    idx = jnp.arange(H, dtype=jnp.int32)
+    found = jnp.zeros((), dtype=bool)
+    best_q = jnp.full((), -1, dtype=jnp.int32)
+    # largest n first: a longer context match is the better prediction
+    for n in range(ngram_max, ngram_min - 1, -1):
+        offs = jnp.arange(n, dtype=jnp.int32)
+        sidx = pos0 - n + 1 + offs
+        suf = hist[jnp.clip(sidx, 0, H - 1)]
+        suffix_ok = (pos0 - n + 1 >= 0) & jnp.all(suf >= 0)
+        # every candidate end position q gets its n-token window [q-n+1, q]
+        widx = idx[:, None] - n + 1 + offs[None, :]            # [H, n]
+        win = hist[jnp.clip(widx, 0, H - 1)]
+        match = (
+            jnp.all(win == suf[None, :], axis=1)
+            & jnp.all(win >= 0, axis=1)
+            & (idx >= n - 1) & (idx < pos0) & suffix_ok
+        )
+        # prefer the most recent match with a FULL k-token continuation
+        # inside known history (on periodic content the nearest match sits
+        # right at the suffix and only has 1-2 known followers); fall back
+        # to the nearest match otherwise
+        q_full = jnp.max(jnp.where(match & (idx + k <= pos0), idx, -1))
+        q_any = jnp.max(jnp.where(match, idx, -1))
+        q = jnp.where(q_full >= 0, q_full, q_any)
+        use = (q >= 0) & ~found
+        best_q = jnp.where(use, q, best_q)
+        found = found | use
+    didx = best_q + 1 + jnp.arange(k, dtype=jnp.int32)
+    d = hist[jnp.clip(didx, 0, H - 1)]
+    # a draft chain stops at the first unknown/overrun position
+    ok = jnp.cumprod(
+        (found & (didx <= pos0) & (d >= 0)).astype(jnp.int32)
+    ).astype(bool)
+    return jnp.where(ok, d, -1).astype(jnp.int32)
+
+
+def propose_drafts(hist: jax.Array, pos0: jax.Array, k: int,
+                   ngram_min: int, ngram_max: int) -> jax.Array:
+    """Batched drafter: hist [B, H], pos0 [B] -> drafts [B, k] (-1-padded)."""
+    return jax.vmap(
+        lambda h, p: _propose_row(h, p, k, ngram_min, ngram_max)
+    )(hist, pos0)
+
+
+def propose_drafts_reference(hist, pos0: int, k: int,
+                             ngram_min: int, ngram_max: int) -> np.ndarray:
+    """Plain-python oracle for one row (tests compare the traced fn to this)."""
+    hist = np.asarray(hist)
+    out = np.full(k, -1, dtype=np.int32)
+    for n in range(ngram_max, ngram_min - 1, -1):
+        if pos0 - n + 1 < 0:
+            continue
+        suf = hist[pos0 - n + 1:pos0 + 1]
+        if (suf < 0).any():
+            continue
+        best = best_full = -1
+        for q in range(n - 1, min(pos0, hist.shape[0])):
+            win = hist[q - n + 1:q + 1]
+            if (win >= 0).all() and (win == suf).all():
+                best = q
+                if q + k <= pos0:
+                    best_full = q
+        if best_full >= 0:
+            best = best_full
+        if best < 0:
+            continue
+        for j in range(k):
+            p = best + 1 + j
+            if p > pos0 or hist[p] < 0:
+                break
+            out[j] = hist[p]
+        return out
+    return out

@@ -92,6 +92,14 @@ class RuntimeConfig:
     trace_export_path: str = ""
     # in-process span ring buffer (serves the /debug/traces endpoint)
     trace_buffer_size: int = 4096
+    # -- speculative decoding defaults (worker flags override) --
+    # "off" | "ngram"; see EngineConfig.spec_mode for semantics
+    spec_mode: str = "off"
+    spec_k: int = 4
+    # acceptance rate below which drafting auto-disables (0 = never),
+    # checked once spec_auto_disable_window draft tokens were verified
+    spec_auto_disable_threshold: float = 0.0
+    spec_auto_disable_window: int = 256
 
     @staticmethod
     def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
@@ -155,6 +163,16 @@ class RuntimeConfig:
         )
         cfg.trace_buffer_size = env_int(
             ENV_PREFIX + "TRACE_BUFFER_SIZE", cfg.trace_buffer_size
+        )
+        cfg.spec_mode = env_str(ENV_PREFIX + "SPEC_MODE", cfg.spec_mode)
+        cfg.spec_k = env_int(ENV_PREFIX + "SPEC_K", cfg.spec_k)
+        cfg.spec_auto_disable_threshold = env_float(
+            ENV_PREFIX + "SPEC_AUTO_DISABLE_THRESHOLD",
+            cfg.spec_auto_disable_threshold,
+        )
+        cfg.spec_auto_disable_window = env_int(
+            ENV_PREFIX + "SPEC_AUTO_DISABLE_WINDOW",
+            cfg.spec_auto_disable_window,
         )
         return cfg
 

@@ -66,6 +66,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "GPipe-microbatched decode; exclusive with --mesh)")
     p.add_argument("--pp-microbatches", type=int, default=4)
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--spec-mode", default=None, choices=["off", "ngram"],
+                   help="speculative decoding: device-side n-gram drafting "
+                        "+ batched verify (default: DYNTPU_SPEC_MODE, off)")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="max draft tokens verified per window "
+                        "(default: DYNTPU_SPEC_K, 4)")
+    p.add_argument("--attention-impl", default="pallas",
+                   choices=["pallas", "einsum", "auto"],
+                   help="decode attention path; 'auto' probes both on the "
+                        "live backend at startup and picks the winner")
     p.add_argument("--drain-timeout", type=float, default=None,
                    help="seconds in-flight streams get to finish on graceful "
                         "drain before being stopped for client migration "
@@ -166,6 +176,12 @@ async def run_worker(args: argparse.Namespace) -> None:
         mesh_shape=(dp, tp),
         pp_stages=args.pp,
         pp_microbatches=args.pp_microbatches,
+        attention_impl=args.attention_impl,
+        spec_mode=(args.spec_mode if args.spec_mode is not None
+                   else config.spec_mode),
+        spec_k=(args.spec_k if args.spec_k is not None else config.spec_k),
+        spec_auto_disable_threshold=config.spec_auto_disable_threshold,
+        spec_auto_disable_window=config.spec_auto_disable_window,
     )
     tokenizer = load_tokenizer(args.tokenizer)
     name = args.model_name or args.model

@@ -7,12 +7,18 @@
 #   scripts/verify.sh resilience  # fault-injection + chaos suites
 #   scripts/verify.sh chaos       # seeded chaos sweep; echoes the repro
 #                                 # seed (DYNTPU_CHAOS_SEED=<n>) on failure
+#   scripts/verify.sh spec        # speculative-decoding parity + accounting
 set -u
 
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "tracing" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tracing \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "spec" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m spec \
         -p no:cacheprovider
 fi
 

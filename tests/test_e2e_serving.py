@@ -119,16 +119,27 @@ async def test_chat_completion_end_to_end(cluster):
 
 @pytest.mark.anyio
 async def test_streaming_end_to_end(cluster):
+    import time
+
+    chunks = []  # (monotonic_stamp, bytes) per HTTP chunk as it lands
     async with aiohttp.ClientSession() as s:
         async with s.post(
             url(cluster, "/v1/completions"),
             json={"model": "tiny-chat", "prompt": "abcdef",
-                  "max_tokens": 5, "stream": True},
+                  "max_tokens": 16, "stream": True},
             timeout=aiohttp.ClientTimeout(total=120),
         ) as r:
             assert r.status == 200
-            raw = (await r.read()).decode()
+            async for data, _ in r.content.iter_chunks():
+                chunks.append((time.monotonic(), data))
+    raw = b"".join(d for _, d in chunks).decode()
     assert raw.rstrip().endswith("data: [DONE]")
+    # pacing: tokens must flush to SSE as they land, not pool in the
+    # fetcher and burst at end-of-stream (the itl_p50_ms=0.0 bug) — so the
+    # stream arrives as multiple receive chunks with non-decreasing stamps
+    stamps = [t for t, _ in chunks]
+    assert stamps == sorted(stamps)
+    assert len(chunks) >= 2, "stream arrived as a single burst"
 
 
 @pytest.mark.anyio

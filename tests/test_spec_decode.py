@@ -1,0 +1,357 @@
+"""Speculative decoding: drafter correctness, greedy/seeded parity with the
+non-spec path (the hard invariant — byte-identical streams), acceptance
+accounting, host-sync efficiency, and metrics/tracing plumbing. All on the
+CPU backend with the tiny model."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import tracing
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.transport import ERR_UNAVAILABLE, EngineError
+from dynamo_tpu.spec import (
+    SpecDecodeStats, propose_drafts, propose_drafts_reference,
+)
+from dynamo_tpu.tracing import InMemorySpanExporter
+
+pytestmark = pytest.mark.spec
+
+MC = ModelConfig.tiny(512)
+
+
+def make_cfg(spec: bool, *, max_num_seqs=4, spec_k=4, pipeline_depth=1,
+             **kw) -> EngineConfig:
+    return EngineConfig(
+        block_size=16, num_blocks=128, max_num_seqs=max_num_seqs,
+        max_num_batched_tokens=256, max_model_len=256,
+        prefill_buckets=(64, 256), decode_buckets=(4, 8),
+        spec_mode="ngram" if spec else "off", spec_k=spec_k,
+        attention_impl="einsum", pipeline_depth=pipeline_depth, **kw,
+    )
+
+
+def mk_req(i, prompt, max_tokens=24, temperature=0.0, seed=-1, top_k=0):
+    return Request(request_id=f"r{i}", token_ids=list(prompt),
+                   max_tokens=max_tokens, temperature=temperature,
+                   top_k=top_k, seed=seed)
+
+
+async def run_engine(spec: bool, reqs, *, engine_seed=0, **cfg_kw):
+    """Run all requests concurrently; returns (token streams, engine facts)."""
+    eng = InferenceEngine(MC, make_cfg(spec, **cfg_kw), seed=engine_seed)
+    await eng.start()
+
+    async def one(r):
+        return [out.token_id async for out in eng.submit(r)]
+
+    streams = await asyncio.gather(*[one(r) for r in reqs])
+    facts = {
+        "stats": eng.spec_stats,
+        "syncs": eng.num_fetch_syncs,
+        "tokens": sum(len(s) for s in streams),
+    }
+    await eng.stop()
+    return streams, facts
+
+
+# ------------------------------ drafter ----------------------------------
+
+
+def test_drafter_matches_reference():
+    """The traced n-gram drafter agrees with the plain-python oracle on
+    random histories with unknown-position (-1) gaps."""
+    rng = np.random.default_rng(7)
+    H, k, nmin, nmax = 48, 4, 1, 3
+    for trial in range(64):
+        hist = rng.integers(2, 9, size=H).astype(np.int32)  # small alphabet
+        for _ in range(rng.integers(0, 4)):                 # poke -1 gaps
+            hist[rng.integers(0, H)] = -1
+        pos0 = int(rng.integers(0, H))
+        hist[pos0 + 1:] = -1  # positions beyond pos0 are unknown
+        got = np.asarray(propose_drafts(
+            np.asarray(hist)[None], np.asarray([pos0], np.int32),
+            k, nmin, nmax,
+        ))[0]
+        want = propose_drafts_reference(hist, pos0, k, nmin, nmax)
+        assert (got == want).all(), (
+            f"trial {trial}: pos0={pos0} got={got} want={want}\n{hist}"
+        )
+
+
+def test_drafter_prefers_full_continuation():
+    """On periodic content the nearest suffix match sits right at the end
+    of history; the drafter must instead pick a match with k known
+    followers so the verify window gets full-length proposals."""
+    hist = np.array([11, 13] * 8 + [-1] * 8, np.int32)
+    pos0 = 15
+    d = np.asarray(propose_drafts(
+        hist[None], np.asarray([pos0], np.int32), 4, 1, 3))[0]
+    assert (d == [11, 13, 11, 13]).all()
+
+
+# --------------------------- stats accounting ----------------------------
+
+
+def test_spec_stats_math():
+    st = SpecDecodeStats()
+    assert st.acceptance_rate == 0.0
+    st.drafted, st.accepted, st.emitted, st.windows = 10, 4, 14, 10
+    assert st.acceptance_rate == pytest.approx(0.4)
+    d = st.to_dict()
+    assert d["drafted"] == 10 and d["acceptance_rate"] == pytest.approx(0.4)
+
+
+def test_spec_stats_from_dict_zero_defaults():
+    """Forward-compat: snapshots from pre-spec workers (missing keys, None)
+    deserialize as all-zero stats rather than raising."""
+    st = SpecDecodeStats.from_dict({})
+    assert (st.drafted, st.accepted, st.windows) == (0, 0, 0)
+    st = SpecDecodeStats.from_dict(None)
+    assert st.acceptance_rate == 0.0
+    st = SpecDecodeStats.from_dict({"drafted": 8, "accepted": 6})
+    assert st.acceptance_rate == pytest.approx(0.75)
+
+
+def test_aggregator_spec_forward_compat():
+    """The aggregator accepts snapshots with and without the "spec" field;
+    absent spec stats read as zeros, present ones feed the gauges."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.utils.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry(prefix="specagg")
+    runtime = SimpleNamespace(
+        metrics=metrics,
+        namespace=lambda *a, **k: SimpleNamespace(
+            component=lambda name: SimpleNamespace(
+                event_subject=lambda s: f"spec.{name}.{s}")),
+    )
+    agg = MetricsAggregator(runtime, "backend")
+    # a pre-spec worker: no "spec" key at all
+    agg._on_stats({"worker_id": 1, "kv_usage": 0.2,
+                   "prefix_cache_hits": 0, "prefix_cache_queries": 0})
+    # a spec-enabled worker
+    agg._on_stats({"worker_id": 2, "kv_usage": 0.5,
+                   "prefix_cache_hits": 0, "prefix_cache_queries": 0,
+                   "spec": {"drafted": 100, "accepted": 60, "emitted": 160,
+                            "windows": 100, "acceptance_rate": 0.6}})
+    body = metrics.render().decode()
+    lines = {ln.split(" ")[0]: ln.split(" ")[1]
+             for ln in body.splitlines()
+             if ln.startswith("specagg_") and not ln.startswith("#")}
+
+    def val(name, **labels):
+        for key, v in lines.items():
+            if key.startswith(name) and all(
+                    f'{k}="{x}"' in key for k, x in labels.items()):
+                return float(v)
+        raise AssertionError(f"{name} {labels} not rendered:\n{body}")
+
+    assert val("specagg_worker_spec_acceptance_rate", worker="1") == 0.0
+    assert val("specagg_worker_spec_acceptance_rate", worker="2") == \
+        pytest.approx(0.6)
+    # aggregate rate pools raw counts across workers (worker 1 adds zeros)
+    assert val("specagg_spec_acceptance_rate") == pytest.approx(0.6)
+
+
+# ------------------------------- parity ----------------------------------
+
+
+async def test_greedy_parity_prompt_shapes():
+    """Hard invariant: spec on vs off produce byte-identical greedy streams
+    across prompt shapes (repetitive, ramp, single-token, mixed tail)."""
+    prompts = [
+        [3, 5, 7, 11] * 12,
+        list(range(2, 30)),
+        [9],
+        [100, 101] * 20 + [7, 8, 9],
+    ]
+
+    def reqs():
+        return [mk_req(i, p) for i, p in enumerate(prompts)]
+
+    off, _ = await run_engine(False, reqs(), max_num_seqs=8)
+    on, facts = await run_engine(True, reqs(), max_num_seqs=8)
+    assert off == on
+    st = facts["stats"]
+    assert st.windows > 0 and st.drafted > 0  # spec actually engaged
+
+
+async def test_seeded_stochastic_parity():
+    """Seeded sampling streams stay identical: stochastic rows draft
+    nothing (greedy-only drafting) and their per-position RNG keys do not
+    shift when greedy neighbours accept multiple tokens per window."""
+    def reqs():
+        return [mk_req(i, [3 + i, 9, 40 + i] * 6, max_tokens=16,
+                       temperature=0.8, seed=42 + i, top_k=8)
+                for i in range(3)]
+
+    off, _ = await run_engine(False, reqs())
+    on, _ = await run_engine(True, reqs())
+    assert off == on
+
+
+async def test_seat_churn_parity():
+    """More requests than decode seats: joins/evictions re-fill draft
+    history mid-flight and parity must survive the churn."""
+    def reqs():
+        return [mk_req(i, [(7 * i) % 90 + 2, 5, 5] * (4 + i % 3),
+                       max_tokens=20) for i in range(8)]
+
+    off, _ = await run_engine(False, reqs(), max_num_seqs=4)
+    on, _ = await run_engine(True, reqs(), max_num_seqs=4)
+    assert off == on
+
+
+class FailoverEngine(AsyncEngine):
+    """Streams from engine A, dies retryably after `fail_after` tokens,
+    then serves the Migration retry (carried prompt) from engine B."""
+
+    def __init__(self, engines, fail_after: int):
+        self.engines = list(engines)
+        self.fail_after = fail_after
+        self.calls = 0
+
+    async def generate(self, request, context):
+        eng = self.engines[min(self.calls, len(self.engines) - 1)]
+        first = self.calls == 0
+        self.calls += 1
+        req = Request(
+            request_id=f"mig-{self.calls}",
+            token_ids=list(request["token_ids"]),
+            max_tokens=int(request["max_tokens"]), temperature=0.0,
+        )
+        i = 0
+        async for out in eng.submit(req):
+            if first and i >= self.fail_after:
+                raise EngineError("worker died", ERR_UNAVAILABLE)
+            yield {"token_ids": [out.token_id], "finished": out.finished,
+                   "finish_reason": out.finish_reason,
+                   "num_prompt_tokens": out.num_prompt_tokens}
+            i += 1
+
+
+async def test_migration_parity_carries_draft_state():
+    """A mid-stream worker failover re-issues the request with carried
+    tokens; the second spec engine rebuilds draft history from the longer
+    prompt and the joined stream still matches an uninterrupted non-spec
+    run exactly."""
+    prompt = [5, 9, 11] * 8
+    max_tokens = 24
+
+    ref, _ = await run_engine(False, [mk_req(0, prompt,
+                                             max_tokens=max_tokens)])
+
+    eng_a = InferenceEngine(MC, make_cfg(True), seed=0)
+    eng_b = InferenceEngine(MC, make_cfg(True), seed=0)
+    await eng_a.start()
+    await eng_b.start()
+    try:
+        failover = FailoverEngine([eng_a, eng_b], fail_after=7)
+        mig = Migration(failover, migration_limit=2, backoff_base_s=0.001)
+        out = []
+        async for item in mig.generate(
+            {"token_ids": prompt, "max_tokens": max_tokens}, Context()
+        ):
+            out.extend(item["token_ids"])
+    finally:
+        await eng_a.stop()
+        await eng_b.stop()
+    assert failover.calls == 2  # the failover actually happened
+    assert out == ref[0]
+    # engine B decoded from a carried prompt — its drafter must have had
+    # history to work with (fed by the seat-join hist fill)
+    assert eng_b.spec_stats.drafted > 0
+
+
+# --------------------------- acceptance accounting -----------------------
+
+
+async def test_acceptance_accounting():
+    """Engine-level SpecDecodeStats invariants after a spec run."""
+    streams, facts = await run_engine(
+        True, [mk_req(0, [4, 6, 8] * 10, max_tokens=32)])
+    st = facts["stats"]
+    assert st.windows > 0
+    assert 0 < st.drafted
+    assert 0 <= st.accepted <= st.drafted
+    assert 0.0 <= st.acceptance_rate <= 1.0
+    # every decode token was emitted by some verify window (the first
+    # token of the stream comes from prefill, not a window)
+    assert st.emitted == facts["tokens"] - 1
+    # each window contributes one non-draft token + its accepted drafts;
+    # the final window may be clamped by the max_tokens budget
+    assert st.emitted <= st.windows + st.accepted
+
+
+async def test_auto_disable_on_low_acceptance():
+    """With an impossible threshold the engine falls back to plain decode
+    after the observation window — one-way, and still stream-correct."""
+    prompt = list(range(2, 26))  # non-repetitive: acceptance stays low
+    off, _ = await run_engine(False, [mk_req(0, prompt, max_tokens=48)])
+    eng = InferenceEngine(
+        MC, make_cfg(True, spec_auto_disable_threshold=1.1,
+                     spec_auto_disable_window=8), seed=0)
+    await eng.start()
+    on = [o.token_id async for o in eng.submit(mk_req(0, prompt,
+                                                      max_tokens=48))]
+    disabled = eng._spec_auto_disabled
+    await eng.stop()
+    assert disabled
+    assert on == off[0]
+
+
+# ------------------------- host-sync efficiency --------------------------
+
+
+async def test_tokens_per_host_sync_improves():
+    """The repetitive-prompt microbench: spec decoding must land >= 1.5x
+    as many tokens per device->host fetch as the non-spec path (ISSUE 5
+    acceptance bar; measured ~3.7x on this workload)."""
+    def reqs():
+        return [mk_req(0, [11, 13] * 16, max_tokens=64)]
+
+    off, f_off = await run_engine(False, reqs(), engine_seed=1)
+    on, f_on = await run_engine(True, reqs(), engine_seed=1)
+    assert off == on
+    tps_off = f_off["tokens"] / max(1, f_off["syncs"])
+    tps_on = f_on["tokens"] / max(1, f_on["syncs"])
+    assert tps_on >= 1.5 * tps_off, (tps_on, tps_off, f_on["stats"])
+
+
+# ------------------------------- tracing ---------------------------------
+
+
+async def test_decode_span_carries_spec_attrs():
+    """SpecDecodeStats surface per-request on the engine.decode span."""
+    tracing.reset()
+    try:
+        tracer = tracing.get_tracer()
+        tracer.configure(sample_ratio=1.0)
+        exp = InMemorySpanExporter()
+        tracer.add_exporter(exp)
+        eng = InferenceEngine(MC, make_cfg(True), seed=0)
+        await eng.start()
+        try:
+            ctx = Context()
+            async for _ in eng.generate(
+                {"token_ids": [7, 9] * 8, "max_tokens": 12,
+                 "temperature": 0.0}, ctx,
+            ):
+                pass
+        finally:
+            await eng.stop()
+        spans = [s for s in exp.spans if s.name == "engine.decode"]
+        assert spans, [s.name for s in exp.spans]
+        attrs = spans[0].attrs
+        assert attrs["spec_drafted"] > 0
+        assert 0 <= attrs["spec_accepted"] <= attrs["spec_drafted"]
+    finally:
+        tracing.reset()
