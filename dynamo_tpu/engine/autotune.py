@@ -3,8 +3,12 @@
 BENCH_r05 measured the Pallas paged-attention decode kernel *losing* to the
 XLA gathered-einsum path on real hardware (kernel_speedup 0.91) — which
 path wins depends on generation/shape, so "auto" times both on the live
-backend at engine startup and picks the winner. The probe is one small
-decode-shaped attention call per impl (~tens of ms), not a model forward.
+backend at engine startup and picks the winner.  The ragged kernel serves
+three distinct shape classes (decode rows, spec ``[B, k+1]`` verify
+windows, prefill chunks) whose arithmetic intensity differs wildly, so each
+class is probed separately and gets its own ``attention_impl_{class}``
+choice.  The probe is one small attention call per (impl, class) — tens of
+ms total, not a model forward.
 
 On non-TPU backends the choice is einsum without probing: Pallas only runs
 in interpret mode there, which is orders of magnitude slower and would both
@@ -35,79 +39,132 @@ def _time_attention(fn, args, iters: int = 20) -> float:
     return (time.perf_counter() - t0) / iters * 1e3
 
 
-def probe_attention_impl(
+def _probe_class(
     model_config: ModelConfig, engine_config: EngineConfig,
-) -> Tuple[EngineConfig, dict]:
-    """Resolve ``attention_impl="auto"`` → a concrete impl.
+    B: int, T: int,
+) -> dict:
+    """Time ragged-Pallas vs gathered-einsum on a ``[B, T]`` chunk shape.
 
-    Returns (engine_config with the winner substituted, choice-info dict
-    with the measured per-call times and ratio). Anything going wrong in
-    the probe falls back to einsum — the always-correct reference path.
+    Rows attend a full ``W * block_size`` context (the chunk is its last
+    ``T`` tokens) — the worst case for the einsum path's gathered scores
+    and the steady state for the kernel's block streaming.
     """
     import jax
     import jax.numpy as jnp
 
-    from ..ops.paged_attention import paged_attention_decode
+    from ..ops.paged_attention import (
+        paged_attention_decode, paged_attention_ragged,
+    )
     from . import model as model_lib
+
+    bs = engine_config.block_size
+    W = max(2, min(8, engine_config.max_blocks_per_seq))
+    KV = model_config.num_kv_heads
+    H = model_config.num_heads
+    hd = model_config.head_dim_
+    NB = 1 + B * W
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if model_config.dtype == "bfloat16" else jnp.float32
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), dt)
+    k = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
+    v = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
+    tables = jnp.asarray(1 + np.arange(B * W).reshape(B, W), jnp.int32)
+    lens = jnp.full((B,), W * bs, jnp.int32)
+
+    if T == 1:
+        kernel = jax.jit(functools.partial(
+            paged_attention_decode, block_size=bs))
+
+        def pallas_path(q, kc, vc, tables, lens):
+            return kernel(q[:, 0], kc, vc, tables, lens)[:, None]
+    else:
+        q_start = jnp.arange(B + 1, dtype=jnp.int32) * T
+        q_lens = jnp.full((B,), T, jnp.int32)
+        kernel = jax.jit(functools.partial(
+            paged_attention_ragged, block_size=bs, max_q_len=T))
+
+        def pallas_path(q, kc, vc, tables, lens):
+            out = kernel(q.reshape(B * T, H, hd), kc, vc, tables,
+                         q_start, q_lens, lens)
+            return out.reshape(B, T, H, hd)
+
+    @jax.jit
+    def einsum_path(q, kc, vc, tables, lens):
+        k_all = jnp.take(kc, tables.reshape(-1), axis=0).reshape(
+            B, W, KV, bs, hd
+        ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
+        v_all = jnp.take(vc, tables.reshape(-1), axis=0).reshape(
+            B, W, KV, bs, hd
+        ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
+        pos = (lens[:, None] - T) + jnp.arange(T)[None, :]
+        return model_lib._attention(q, k_all, v_all, pos)
+
+    args = (q, k, v, tables, lens)
+    pallas_ms = _time_attention(jax.jit(pallas_path), args)
+    einsum_ms = _time_attention(einsum_path, args)
+    return {
+        "impl": "pallas" if pallas_ms < einsum_ms else "einsum",
+        "B": B, "T": T,
+        "pallas_ms": round(pallas_ms, 4),
+        "einsum_ms": round(einsum_ms, 4),
+        # >1 means the Pallas kernel is faster
+        "ratio": round(einsum_ms / max(pallas_ms, 1e-9), 3),
+    }
+
+
+def probe_attention_impl(
+    model_config: ModelConfig, engine_config: EngineConfig,
+) -> Tuple[EngineConfig, dict]:
+    """Resolve ``attention_impl="auto"`` → concrete per-class impls.
+
+    Returns (engine_config with the winners substituted — ``attention_impl``
+    carries the decode winner for back-compat and each
+    ``attention_impl_{decode,spec,prefill}`` its class winner — plus a
+    choice-info dict with the per-class times and ratios under "classes").
+    Anything going wrong in a probe falls back to einsum — the
+    always-correct reference path.
+    """
+    import jax
 
     if engine_config.attention_impl != "auto":
         return engine_config, {
             "impl": engine_config.attention_impl, "probed": False,
         }
 
-    choice: dict = {"probed": False}
+    choice: dict = {"probed": False, "classes": {}}
+    impls = {"decode": "einsum", "spec": "einsum", "prefill": "einsum"}
     if jax.default_backend() != "tpu":
         # interpret-mode Pallas is not a contender; don't burn startup time
         choice.update(impl="einsum", reason="non-tpu backend")
     else:
-        try:
-            bs = engine_config.block_size
-            B = min(16, max(engine_config.decode_buckets))
-            W = max(2, min(8, engine_config.max_blocks_per_seq))
-            KV = model_config.num_kv_heads
-            H = model_config.num_heads
-            hd = model_config.head_dim_
-            NB = 1 + B * W
-            rng = np.random.default_rng(0)
-            dt = jnp.bfloat16 if model_config.dtype == "bfloat16" \
-                else jnp.float32
-            q = jnp.asarray(rng.standard_normal((B, H, hd)), dt)
-            k = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
-            v = jnp.asarray(rng.standard_normal((NB, KV, bs, hd)), dt)
-            tables = jnp.asarray(
-                1 + np.arange(B * W).reshape(B, W), jnp.int32)
-            lens = jnp.full((B,), W * bs, jnp.int32)
-
-            kernel = jax.jit(functools.partial(
-                paged_attention_decode, block_size=bs))
-
-            @jax.jit
-            def einsum_path(q, kc, vc, tables, lens):
-                k_all = jnp.take(kc, tables.reshape(-1), axis=0).reshape(
-                    B, W, KV, bs, hd
-                ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
-                v_all = jnp.take(vc, tables.reshape(-1), axis=0).reshape(
-                    B, W, KV, bs, hd
-                ).transpose(0, 1, 3, 2, 4).reshape(B, W * bs, KV, hd)
-                pos = (lens - 1)[:, None]
-                return model_lib._attention(q[:, None], k_all, v_all,
-                                            pos)[:, 0]
-
-            args = (q, k, v, tables, lens)
-            pallas_ms = _time_attention(kernel, args)
-            einsum_ms = _time_attention(einsum_path, args)
-            impl = "pallas" if pallas_ms < einsum_ms else "einsum"
-            choice.update(
-                impl=impl, probed=True,
-                pallas_ms=round(pallas_ms, 4),
-                einsum_ms=round(einsum_ms, 4),
-                # >1 means the Pallas kernel is faster
-                ratio=round(einsum_ms / max(pallas_ms, 1e-9), 3),
-            )
-        except Exception as e:
-            choice.update(impl="einsum",
-                          reason=f"probe failed: {type(e).__name__}: {e}")
+        B_dec = min(16, max(engine_config.decode_buckets))
+        shapes = {"decode": (B_dec, 1)}
+        if engine_config.spec_mode != "off":
+            shapes["spec"] = (B_dec, engine_config.spec_k + 1)
+        shapes["prefill"] = (4, min(256, max(engine_config.prefill_buckets)))
+        for cls, (B, T) in shapes.items():
+            try:
+                info = _probe_class(model_config, engine_config, B, T)
+                impls[cls] = info["impl"]
+                choice["classes"][cls] = info
+                choice["probed"] = True
+            except Exception as e:
+                choice["classes"][cls] = {
+                    "impl": "einsum",
+                    "reason": f"probe failed: {type(e).__name__}: {e}",
+                }
+        choice["impl"] = impls["decode"]
+        # legacy top-level fields mirror the decode class (bench back-compat)
+        dec = choice["classes"].get("decode", {})
+        for key in ("pallas_ms", "einsum_ms", "ratio"):
+            if key in dec:
+                choice[key] = dec[key]
     log.info("attention_impl=auto resolved: %s", choice)
     resolved = dataclasses.replace(
-        engine_config, attention_impl=choice["impl"])
+        engine_config,
+        attention_impl=choice.get("impl", "einsum"),
+        attention_impl_decode=impls["decode"],
+        attention_impl_spec=impls["spec"],
+        attention_impl_prefill=impls["prefill"],
+    )
     return resolved, choice

@@ -113,8 +113,21 @@ class EngineConfig:
     mesh_shape: Tuple[int, int] = (1, 1)
     # decode attention implementation: "pallas" streams KV blocks HBM→VMEM
     # with online softmax (ops/paged_attention.py); "einsum" materialises the
-    # gathered context (the XLA-fusion reference path)
+    # gathered context (the XLA-fusion reference path); "auto" microprobes
+    # both at engine startup (engine/autotune.py)
     attention_impl: str = "pallas"
+    # per-shape-class overrides for the ragged kernel ("" = inherit:
+    # decode follows attention_impl, spec/prefill default to einsum).
+    # attention_impl="auto" fills all three from the startup microprobe.
+    attention_impl_decode: str = ""
+    attention_impl_spec: str = ""
+    attention_impl_prefill: str = ""
+    # chunked prefill: cap each prefill chunk at this many tokens so long
+    # prompts are admitted in slices interleaved with running decodes under
+    # max_num_batched_tokens, instead of one whole-prompt stall that blows
+    # up TTFT p99 for everyone behind it. 0 = off (chunks capped only by
+    # the largest prefill bucket).
+    prefill_chunk_tokens: int = 0
     # tokens generated per decode window (>1 chains steps on device via an
     # UNROLLED window fed from the device token ring, amortising the
     # host↔device roundtrip; tokens past a sequence's EOS/capacity inside
@@ -169,6 +182,18 @@ class EngineConfig:
             raise ValueError("max_num_seqs exceeds largest decode bucket")
         if self.spec_mode not in ("off", "ngram"):
             raise ValueError(f"unknown spec_mode {self.spec_mode!r}")
+        if self.attention_impl not in ("pallas", "einsum", "auto"):
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}"
+            )
+        for cls in ("decode", "spec", "prefill"):
+            v = getattr(self, f"attention_impl_{cls}")
+            if v not in ("", "pallas", "einsum"):
+                raise ValueError(
+                    f"unknown attention_impl_{cls} {v!r}"
+                )
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError("prefill_chunk_tokens must be >= 0")
         if self.spec_mode != "off":
             if self.spec_k < 1:
                 raise ValueError("spec_k must be >= 1")

@@ -817,6 +817,28 @@ class InferenceEngine(EngineCore):
             engine_config, self.attention_impl_choice = (
                 probe_attention_impl(model_config, engine_config)
             )
+        if engine_config.prefill_chunk_tokens > 0:
+            pct = max(engine_config.prefill_chunk_tokens,
+                      engine_config.block_size)
+            cap = min(pct, max(engine_config.prefill_buckets))
+            bucket = min(
+                (b for b in engine_config.prefill_buckets if b >= cap),
+                default=max(engine_config.prefill_buckets),
+            )
+            log.info(
+                "chunked prefill: prompts admitted in %d-token chunks "
+                "interleaved with decode", cap,
+            )
+            if bucket != cap:
+                # every chunk pads up to a compiled bucket; a cap off the
+                # bucket grid silently burns the difference each dispatch
+                log.warning(
+                    "prefill_chunk_tokens=%d is not a prefill bucket — "
+                    "chunks pad to the %d bucket (%d wasted tokens each); "
+                    "consider a bucket-sized cap %r",
+                    cap, bucket, bucket - cap,
+                    engine_config.prefill_buckets,
+                )
         super().__init__(engine_config)
         self.model_config = model_config
         self.pp = engine_config.pp_stages

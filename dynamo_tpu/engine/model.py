@@ -240,6 +240,36 @@ def _attention(
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
+def attention_class(eng: EngineConfig, T: int) -> str:
+    """Shape class of a ``[B, T]`` chunk: decode / spec / prefill.
+
+    T is static at trace time, so the class (and the impl picked from it)
+    is baked into each compiled step function.
+    """
+    if T == 1:
+        return "decode"
+    if eng.spec_mode != "off" and T <= eng.spec_k + 1:
+        return "spec"
+    return "prefill"
+
+
+def resolve_attention_impl(eng: EngineConfig, attn_class: str) -> str:
+    """Resolve the attention impl ("pallas" | "einsum") for a shape class.
+
+    Per-class overrides (``attention_impl_{decode,spec,prefill}``, set
+    explicitly or by the autotune probe) win; otherwise decode follows the
+    legacy ``attention_impl`` knob and the T>1 classes default to einsum —
+    running every CPU test's prefills through interpret-mode Pallas would
+    be pointlessly slow, and on TPU the autotuner sets the fields anyway.
+    """
+    override = getattr(eng, f"attention_impl_{attn_class}", "")
+    if override:
+        return override
+    if attn_class == "decode" and eng.attention_impl == "pallas":
+        return "pallas"
+    return "einsum"
+
+
 def _paged_decode_attention(
     eng: EngineConfig,
     mesh: Optional[Mesh],
@@ -279,6 +309,54 @@ def _paged_decode_attention(
     else:
         out = kernel(q3, lk, lv, block_tables, seq_lens)
     return out[:, None]
+
+
+def _paged_ragged_attention(
+    eng: EngineConfig,
+    mesh: Optional[Mesh],
+    q: jax.Array,             # [B, T, H, hd]
+    lk: jax.Array,            # [NB, KV, bs, hd] this layer's cache (updated)
+    lv: jax.Array,            # [NB, KV, bs, hd]
+    block_tables: jax.Array,  # [B, W]
+    q_len: jax.Array,         # [B] valid (prefix) queries per row, 0 = dead
+    ctx_len: jax.Array,       # [B] context incl. the row's own tokens
+) -> jax.Array:
+    """T>1 attention (spec windows, prefill chunks) via the ragged kernel.
+
+    Rows pack flat with stride T (``q_start = arange(B+1) * T``); the
+    forward contract guarantees valid tokens are a per-row prefix, which
+    is exactly the ragged layout.  Sharding story mirrors
+    ``_paged_decode_attention``.
+    """
+    from ..ops.paged_attention import paged_attention_ragged
+
+    B, T, H, hd = q.shape
+    interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(
+        paged_attention_ragged,
+        block_size=eng.block_size,
+        max_q_len=T,
+        interpret=interpret,
+    )
+    q_flat = q.reshape(B * T, H, hd)
+    q_start = jnp.arange(B + 1, dtype=jnp.int32) * T
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        out = jax.shard_map(
+            lambda q_, k_, v_, t_, s_, ql_, cl_: kernel(
+                q_, k_, v_, t_, s_, ql_, cl_
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, "tp", None), P(None, "tp", None, None),
+                P(None, "tp", None, None), P(None, None), P(None),
+                P(None), P(None),
+            ),
+            out_specs=P(None, "tp", None),
+            check_vma=False,  # pallas_call outputs carry no vma info
+        )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
+    else:
+        out = kernel(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
+    return out.reshape(B, T, H, hd)
 
 
 def forward(
@@ -336,8 +414,17 @@ def forward(
     scatter_block = jnp.where(positions >= 0, phys_block, 0).reshape(-1)
     scatter_off = jnp.where(positions >= 0, pos_safe % bs, 0).reshape(-1)
 
-    use_pallas = T == 1 and eng.attention_impl == "pallas"
-    seq_lens = jnp.maximum(positions[:, 0] + 1, 0) if use_pallas else None
+    attn_impl = resolve_attention_impl(eng, attention_class(eng, T))
+    use_pallas = not use_ring and attn_impl == "pallas"
+    seq_lens = q_len = ctx_len = None
+    if use_pallas:
+        if T == 1:
+            seq_lens = jnp.maximum(positions[:, 0] + 1, 0)
+        else:
+            # valid tokens are a per-row prefix (the spec/prefill feed
+            # contract), so count + max give the ragged-kernel metadata
+            q_len = jnp.sum(positions >= 0, axis=1).astype(jnp.int32)
+            ctx_len = jnp.maximum(jnp.max(positions, axis=1) + 1, 0)
 
     # Unrolled layer loop (NOT lax.scan): each layer's cache buffer is
     # donated and scatter-updated in place; a scanned stacked cache is
@@ -377,9 +464,13 @@ def forward(
                 out_specs=spec,
                 check_vma=False,
             )(q, k, v)
-        elif use_pallas:
+        elif use_pallas and T == 1:
             attn = _paged_decode_attention(
                 eng, mesh, q, lk, lv, block_tables, seq_lens
+            )
+        elif use_pallas:
+            attn = _paged_ragged_attention(
+                eng, mesh, q, lk, lv, block_tables, q_len, ctx_len
             )
         else:
             # gather the full context for attention: [B, W*bs, KV, hd] with

@@ -471,8 +471,17 @@ class Scheduler:
                 # decode seats don't force prompt splits (a 512 prompt
                 # split 448+64 costs a full extra dispatch + uploads).
                 max_bucket = max(self.config.prefill_buckets)
-                chunk = min(budget, remaining, max_bucket)
-                if (chunk < remaining and chunk < max_bucket
+                eff_cap = max_bucket
+                pct = self.config.prefill_chunk_tokens
+                if pct > 0:
+                    # chunked prefill: slice long prompts into pct-token
+                    # chunks interleaved with running decodes, instead of
+                    # one whole-prompt stall. Never below a block so chunk
+                    # boundaries can't strand a partial block's worth of
+                    # budget forever.
+                    eff_cap = min(max_bucket, max(pct, bs))
+                chunk = min(budget, remaining, eff_cap)
+                if (chunk < remaining and chunk < eff_cap
                         and batch.prefills):
                     # fragment caused by earlier prefills eating the
                     # budget: the tail would cost a whole extra dispatch

@@ -100,6 +100,10 @@ class RuntimeConfig:
     # checked once spec_auto_disable_window draft tokens were verified
     spec_auto_disable_threshold: float = 0.0
     spec_auto_disable_window: int = 256
+    # chunked prefill: per-chunk token cap so long prompts interleave with
+    # running decodes (0 = whole-bucket prefill); see
+    # EngineConfig.prefill_chunk_tokens
+    prefill_chunk_tokens: int = 0
 
     @staticmethod
     def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
@@ -173,6 +177,9 @@ class RuntimeConfig:
         cfg.spec_auto_disable_window = env_int(
             ENV_PREFIX + "SPEC_AUTO_DISABLE_WINDOW",
             cfg.spec_auto_disable_window,
+        )
+        cfg.prefill_chunk_tokens = env_int(
+            ENV_PREFIX + "PREFILL_CHUNK_TOKENS", cfg.prefill_chunk_tokens
         )
         return cfg
 

@@ -75,7 +75,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--attention-impl", default="pallas",
                    choices=["pallas", "einsum", "auto"],
                    help="decode attention path; 'auto' probes both on the "
-                        "live backend at startup and picks the winner")
+                        "live backend at startup and picks per-shape-class "
+                        "winners (decode / spec window / prefill chunk)")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                   help="cap each prefill chunk at this many tokens so long "
+                        "prompts interleave with running decodes instead of "
+                        "stalling them (0 = whole-bucket prefill; default: "
+                        "DYNTPU_PREFILL_CHUNK_TOKENS, 0)")
     p.add_argument("--drain-timeout", type=float, default=None,
                    help="seconds in-flight streams get to finish on graceful "
                         "drain before being stopped for client migration "
@@ -177,6 +183,11 @@ async def run_worker(args: argparse.Namespace) -> None:
         pp_stages=args.pp,
         pp_microbatches=args.pp_microbatches,
         attention_impl=args.attention_impl,
+        prefill_chunk_tokens=(
+            args.prefill_chunk_tokens
+            if args.prefill_chunk_tokens is not None
+            else config.prefill_chunk_tokens
+        ),
         spec_mode=(args.spec_mode if args.spec_mode is not None
                    else config.spec_mode),
         spec_k=(args.spec_k if args.spec_k is not None else config.spec_k),

@@ -8,6 +8,8 @@
 #   scripts/verify.sh chaos       # seeded chaos sweep; echoes the repro
 #                                 # seed (DYNTPU_CHAOS_SEED=<n>) on failure
 #   scripts/verify.sh spec        # speculative-decoding parity + accounting
+#   scripts/verify.sh kernel      # ragged paged-attention interpret-mode
+#                                 # parity suite (CPU, no TPU needed)
 set -u
 
 cd "$(dirname "$0")/.."
@@ -19,6 +21,11 @@ fi
 
 if [ "${1:-}" = "spec" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m spec \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "kernel" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernel \
         -p no:cacheprovider
 fi
 

@@ -1,0 +1,199 @@
+"""Ragged paged-attention kernel: CPU interpret-mode parity vs a numpy
+reference across mixed shape classes (decode rows, spec windows, prefill
+chunks), GQA group sizes, partial last blocks, seat churn, and the
+trash-block / NaN-poisoning contract. Runs without TPU hardware."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_decode, paged_attention_ragged,
+)
+
+pytestmark = pytest.mark.kernel
+
+
+def _reference(q, k_cache, v_cache, tables, q_start, q_len, ctx_len, bs):
+    """Loop-nest reference: query i of row r sits at absolute position
+    ctx_len[r] - q_len[r] + i and sees key positions <= that."""
+    Tq, H, hd = q.shape
+    KV = k_cache.shape[1]
+    G = H // KV
+    out = np.zeros_like(q, dtype=np.float32)
+    for r in range(len(q_len)):
+        cl = int(ctx_len[r])
+        keys = np.zeros((cl, KV, hd), np.float32)
+        vals = np.zeros((cl, KV, hd), np.float32)
+        for pos in range(cl):
+            blk, off = int(tables[r, pos // bs]), pos % bs
+            keys[pos] = k_cache[blk, :, off]
+            vals[pos] = v_cache[blk, :, off]
+        for i in range(int(q_len[r])):
+            vis = cl - int(q_len[r]) + i + 1
+            for h in range(H):
+                kv = h // G
+                s = keys[:vis, kv] @ q[q_start[r] + i, h] / np.sqrt(hd)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[q_start[r] + i, h] = p @ vals[:vis, kv]
+    return out
+
+
+def _make_case(rows, *, G=2, KV=2, hd=64, bs=16, W=8, q_tile=4, seed=0,
+               poison_trash=True, poison_tails=True):
+    """Build a ragged batch. ``rows`` is a list of (q_len, ctx_len,
+    alloc_tiles). Block tables are allocated contiguously from block 1;
+    the trash block 0 and (optionally) the dead tail of each partial last
+    block are filled with NaN to assert they can never leak."""
+    rng = np.random.default_rng(seed)
+    H = KV * G
+    q_start = [0]
+    for ql, cl, al in rows:
+        assert ql <= al * q_tile <= max(al * q_tile, 1)
+        q_start.append(q_start[-1] + al * q_tile)
+    Tq = q_start[-1]
+    nb = 1 + sum((cl + bs - 1) // bs for _, cl, _ in rows) + 2
+    q = rng.standard_normal((Tq, H, hd)).astype(np.float32)
+    k_cache = rng.standard_normal((nb, KV, bs, hd)).astype(np.float32)
+    v_cache = rng.standard_normal((nb, KV, bs, hd)).astype(np.float32)
+    if poison_trash:
+        k_cache[0] = np.nan
+        v_cache[0] = np.nan
+    tables = np.zeros((len(rows), W), np.int32)
+    nxt = 1
+    for r, (ql, cl, al) in enumerate(rows):
+        for w in range((cl + bs - 1) // bs):
+            tables[r, w] = nxt
+            nxt += 1
+        if poison_tails and cl % bs and cl > 0:
+            blk = tables[r, cl // bs]
+            k_cache[blk, :, cl % bs:] = np.nan
+            v_cache[blk, :, cl % bs:] = np.nan
+    return (q, k_cache, v_cache, tables,
+            np.asarray(q_start, np.int32),
+            np.asarray([r[0] for r in rows], np.int32),
+            np.asarray([r[1] for r in rows], np.int32), bs, q_tile)
+
+
+def _run(case, max_q_len=None):
+    q, k, v, tables, q_start, q_len, ctx_len, bs, q_tile = case
+    if max_q_len is None:
+        max_q_len = int(np.max(np.diff(q_start)))
+    out = paged_attention_ragged(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(tables),
+        jnp.asarray(q_start), jnp.asarray(q_len), jnp.asarray(ctx_len),
+        block_size=bs, max_q_len=max_q_len, q_tile=q_tile, interpret=True,
+    )
+    return np.asarray(out)
+
+
+def _check(case, tol=2e-3):
+    q, k, v, tables, q_start, q_len, ctx_len, bs, _ = case
+    out = _run(case)
+    assert np.isfinite(out).all(), "kernel leaked NaN/inf"
+    ref = _reference(np.nan_to_num(q),
+                     np.nan_to_num(k), np.nan_to_num(v),
+                     tables, q_start, q_len, ctx_len, bs)
+    err = np.max(np.abs(out - ref))
+    assert err <= tol, f"max abs err {err}"
+    return out
+
+
+def test_mixed_ragged_batch():
+    # one launch over every serving shape class: a decode row, a spec
+    # verify window, a fresh prefill chunk (ctx == q_len), a continuation
+    # chunk with history, and a dead seat
+    rows = [
+        (1, 37, 1),    # decode, partial last block
+        (4, 20, 1),    # spec window [k+1] with history
+        (8, 8, 2),     # fresh prefill chunk
+        (0, 0, 1),     # dead / freshly-reset seat
+        (6, 50, 2),    # continuation chunk, partial tile tail
+    ]
+    case = _make_case(rows)
+    out = _check(case)
+    # every slot of the dead row comes back exactly zero
+    q_start = case[4]
+    assert np.all(out[q_start[3]:q_start[4]] == 0.0)
+
+
+@pytest.mark.parametrize("G", [1, 2, 4])
+def test_gqa_group_sizes(G):
+    rows = [(1, 17, 1), (4, 4, 1), (5, 33, 2)]
+    _check(_make_case(rows, G=G, KV=2, seed=G))
+
+
+def test_partial_last_blocks():
+    # every ctx_len lands mid-block; poisoned tails must not leak
+    rows = [(1, 1, 1), (1, 15, 1), (3, 19, 1), (7, 31, 2)]
+    _check(_make_case(rows, bs=16, seed=3))
+
+
+def test_all_trash_rows():
+    # regression for the trash-block contract: a whole batch of
+    # freshly-reset seats (q_len == 0, tables all 0, block 0 NaN) must
+    # emit exact zeros and never NaN-poison the online softmax
+    rows = [(0, 0, 1)] * 4
+    case = _make_case(rows, seed=4)
+    out = _run(case)
+    assert np.all(out == 0.0)
+
+
+def test_stale_table_tails_beyond_ctx():
+    # seat churn: table entries past ctx_len point at recycled blocks
+    # holding other sequences' (here: poisoned) data — invisible by mask
+    case = _make_case([(1, 20, 1), (4, 10, 1)], seed=5)
+    q, k, v, tables, q_start, q_len, ctx_len, bs, q_tile = case
+    stale = np.array(tables)
+    nb = k.shape[0]
+    for r in range(stale.shape[0]):
+        used = (int(ctx_len[r]) + bs - 1) // bs
+        stale[r, used:] = nb - 1
+    k[nb - 1] = np.nan
+    v[nb - 1] = np.nan
+    out = _run((q, k, v, stale, q_start, q_len, ctx_len, bs, q_tile))
+    assert np.isfinite(out).all()
+    ref = _reference(q, np.nan_to_num(k), np.nan_to_num(v), stale,
+                     q_start, q_len, ctx_len, bs)
+    assert np.max(np.abs(out - ref)) <= 2e-3
+
+
+def test_q_tile_variants_agree():
+    # same batch, different static tilings → identical numerics
+    rows = [(8, 24, 1), (3, 40, 1), (8, 8, 1)]
+    outs = []
+    for q_tile in (1, 2, 4, 8):
+        case = _make_case(rows, q_tile=8, seed=6)
+        q, k, v, tables, q_start, q_len, ctx_len, bs, _ = case
+        outs.append(_run((q, k, v, tables, q_start, q_len, ctx_len, bs,
+                          q_tile)))
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], atol=1e-5)
+
+
+def test_decode_wrapper_matches_ragged():
+    # paged_attention_decode is the q_tile=1 face of the ragged kernel
+    rng = np.random.default_rng(7)
+    B, KV, G, hd, bs, W = 4, 2, 2, 32, 16, 4
+    H = KV * G
+    nb = 1 + B * W
+    q = rng.standard_normal((B, H, hd)).astype(np.float32)
+    k = rng.standard_normal((nb, KV, bs, hd)).astype(np.float32)
+    v = rng.standard_normal((nb, KV, bs, hd)).astype(np.float32)
+    tables = 1 + np.arange(B * W, dtype=np.int32).reshape(B, W)
+    lens = np.asarray([1, 17, 0, 64], np.int32)
+    out = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(tables), jnp.asarray(lens),
+        block_size=bs, interpret=True,
+    )
+    out = np.asarray(out)
+    assert np.all(out[2] == 0.0)
+    ref = _reference(
+        q, k, v, tables,
+        np.arange(B + 1, dtype=np.int32),
+        (lens > 0).astype(np.int32), lens, bs,
+    )
+    assert np.max(np.abs(out - ref)) <= 2e-3
