@@ -612,6 +612,56 @@ def _run_attempt_inner(env, probe_timeout, bench_timeout, stderr_file):
         return None, platform, f"bad bench JSON: {e}"
 
 
+async def run_planner_sim() -> dict:
+    """SLO columns for the bench trajectory: one compact simulated-cluster
+    run (CPU-only, seconds) through the live planner/orchestrator loop —
+    request-level slo_violation_rate plus per-tier TTFT/ITL percentiles."""
+    import logging
+
+    logging.getLogger("dynamo_tpu").setLevel(logging.WARNING)
+    from dynamo_tpu.mocker.cluster import SimScenario, run_scenario
+
+    seed = int(os.environ.get("BENCH_PLANNER_SEED", 0))
+    with tempfile.TemporaryDirectory() as workdir:
+        rep = await run_scenario(SimScenario(seed=seed), workdir)
+    rate = rep["slo_violation_rate"]
+    fields = {
+        "slo_violation_rate": (round(rate, 4) if rate is not None else None),
+        "sim_recovery_windows": rep["recovery_windows"],
+        "sim_requests": rep["num_requests"],
+        "sim_shed": rep["num_shed_total"],
+        "sim_degradation_max_level": rep["degradation_max_level"],
+        "sim_seed": seed,
+    }
+    for tier, summary in sorted(rep["tiers"].items()):
+        for key in ("ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s"):
+            value = summary.get(key)
+            fields[f"tier{tier}_{key[:-2]}_ms"] = (
+                round(value * 1000.0, 2) if value is not None else None)
+    return fields
+
+
+def _planner_sim_fields(base_env: dict, timeout_s: float = 180.0) -> dict:
+    """Run the sim in a CPU-pinned subprocess so a TPU bench run never loads
+    extra state into this process; any failure degrades to an error note,
+    never a broken bench. BENCH_PLANNER_SIM=0 skips it entirely."""
+    if os.environ.get("BENCH_PLANNER_SIM", "1").lower() in ("0", "false",
+                                                            "off"):
+        return {}
+    env = dict(base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--planner-sim"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        line = next(ln for ln in reversed(out.stdout.splitlines())
+                    if ln.startswith("{"))
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — must never break the bench
+        return {"planner_sim_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> None:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     bench_timeout = float(os.environ.get("BENCH_TIMEOUT", 2400))
@@ -657,6 +707,7 @@ def main() -> None:
         }
     if errors:
         result["error"] = "; ".join(errors)
+    result.update(_planner_sim_fields(base_env))
     print(json.dumps(result))
 
 
@@ -665,5 +716,9 @@ if __name__ == "__main__":
         import asyncio
 
         print(json.dumps(asyncio.run(run_bench())))
+    elif "--planner-sim" in sys.argv:
+        import asyncio
+
+        print(json.dumps(asyncio.run(run_planner_sim())))
     else:
         main()

@@ -201,6 +201,16 @@ async def run_frontend(args: argparse.Namespace) -> None:
                 await asyncio.sleep(args.stats_publish_interval)
                 win = service.window_stats.drain()
                 win["interval_s"] = args.stats_publish_interval
+                # live pressure signals riding the same payload: admission
+                # backlog and router breaker states (planner feeds)
+                if service.admission is not None:
+                    win["queue_depth"] = service.admission.queue_depth
+                win["breaker_open"] = sum(
+                    1
+                    for router in kv_routers.values()
+                    for state in router.breakers.states().values()
+                    if state != "closed"
+                )
                 try:
                     await runtime.store.publish(
                         subject, msgpack.packb(win)
@@ -209,6 +219,16 @@ async def run_frontend(args: argparse.Namespace) -> None:
                     log.warning("frontend stats publish failed: %s", exc)
 
         stats_task = asyncio.create_task(_publish_stats())
+
+    # the planner's degradation ladder orders tier shedding through the
+    # store; apply it to admission as the orders move
+    from ..planner.degradation import DegradationWatcher
+
+    degradation_watcher = DegradationWatcher(
+        runtime.store, runtime.namespace().name,
+        service.apply_degradation,
+    )
+    degradation_watcher.start()
 
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -219,6 +239,7 @@ async def run_frontend(args: argparse.Namespace) -> None:
     async def _shutdown():
         if stats_task is not None:
             stats_task.cancel()
+        await degradation_watcher.stop()
         await watcher.stop()
         if grpc_service is not None:
             await grpc_service.stop()

@@ -11,6 +11,7 @@ health + Prometheus metrics, SSE streaming with aggregation for
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Dict, List, Optional
@@ -31,6 +32,11 @@ log = get_logger("frontend.http")
 # per-request deadline override (milliseconds); clamped to the service's
 # configured ceiling so a client cannot buy unbounded worker time
 TIMEOUT_HEADER = "X-Request-Timeout-Ms"
+
+# request priority tier (integer, higher = more important); under graceful
+# degradation the planner orders admission to shed tiers below a cutoff
+TIER_HEADER = "X-Request-Tier"
+DEFAULT_TIER = 1
 
 
 class AdmissionError(Exception):
@@ -54,17 +60,24 @@ class AdmissionController:
     Slot handoff: a release with waiters queued passes the slot to the
     oldest waiter without touching the active count, so the limiter is FIFO
     and never overshoots.
+
+    Tier-aware shedding: when the planner's degradation ladder sets
+    ``min_tier`` > 0, requests tagged with a lower tier are rejected at the
+    door regardless of free capacity — the cheapest relief valve, released
+    first as pressure falls.
     """
 
     def __init__(self, max_concurrency: int, max_queue: int = 0,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0, min_tier: int = 0):
         self.max_concurrency = max_concurrency
         self.max_queue = max_queue
         self.retry_after_s = retry_after_s
+        self.min_tier = min_tier
         self._active = 0
         self._queue: List[asyncio.Future] = []
         self.num_admitted = 0
         self.num_shed = 0
+        self.num_tier_shed = 0
 
     @property
     def active(self) -> int:
@@ -74,7 +87,16 @@ class AdmissionController:
     def queue_depth(self) -> int:
         return len(self._queue)
 
-    async def acquire(self, deadline: Optional[float] = None) -> None:
+    async def acquire(self, deadline: Optional[float] = None,
+                      tier: int = DEFAULT_TIER) -> None:
+        if tier < self.min_tier:
+            self.num_shed += 1
+            self.num_tier_shed += 1
+            raise AdmissionError(
+                429, self.retry_after_s,
+                f"tier {tier} shed under degradation "
+                f"(min admitted tier {self.min_tier})",
+            )
         if self._active < self.max_concurrency:
             self._active += 1
             self.num_admitted += 1
@@ -178,12 +200,34 @@ class ModelManager:
         return name in self._models
 
 
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated percentile of an unsorted sample list."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    if len(xs) == 1:
+        return xs[0]
+    pos = (len(xs) - 1) * q
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * frac
+
+
 class WindowStats:
     """Per-window request aggregates for the SLA planner
     (ref: the Prometheus series planner_core.py:193 observe_metrics pulls;
-    here collected in-process and published on the store)."""
+    here collected in-process and published on the store).
 
-    def __init__(self) -> None:
+    Latency samples are kept in bounded reservoirs (uniform replacement,
+    seeded RNG) so the drained window carries p50/p99 tails — the planner
+    enforces SLAs on percentiles, not averages that hide a melting tail."""
+
+    RESERVOIR = 2048
+
+    def __init__(self, reservoir: int = RESERVOIR) -> None:
+        self.reservoir = reservoir
+        self._rng = random.Random(0)
         self.reset()
 
     def reset(self) -> None:
@@ -194,9 +238,34 @@ class WindowStats:
         self.ttft_count = 0
         self.itl_sum = 0.0
         self.itl_count = 0
+        self.ttft_samples: List[float] = []
+        self.itl_samples: List[float] = []
+        self._ttft_seen = 0
+        self._itl_seen = 0
+
+    def _sample(self, samples: List[float], seen: int, value: float) -> None:
+        if len(samples) < self.reservoir:
+            samples.append(value)
+        else:
+            j = self._rng.randrange(seen)
+            if j < self.reservoir:
+                samples[j] = value
+
+    def record_ttft(self, value_s: float) -> None:
+        self.ttft_sum += value_s
+        self.ttft_count += 1
+        self._ttft_seen += 1
+        self._sample(self.ttft_samples, self._ttft_seen, value_s)
+
+    def record_itl(self, value_s: float) -> None:
+        self.itl_sum += value_s
+        self.itl_count += 1
+        self._itl_seen += 1
+        self._sample(self.itl_samples, self._itl_seen, value_s)
 
     def drain(self) -> dict:
-        """Snapshot + reset; averages are None when nothing was observed."""
+        """Snapshot + reset; averages/percentiles are None when nothing was
+        observed."""
         out = {
             "num_requests": self.num_requests,
             "isl_avg": (self.isl_sum / self.num_requests
@@ -207,6 +276,10 @@ class WindowStats:
                            if self.ttft_count else None),
             "itl_avg_s": (self.itl_sum / self.itl_count
                           if self.itl_count else None),
+            "ttft_p50_s": percentile(self.ttft_samples, 0.50),
+            "ttft_p99_s": percentile(self.ttft_samples, 0.99),
+            "itl_p50_s": percentile(self.itl_samples, 0.50),
+            "itl_p99_s": percentile(self.itl_samples, 0.99),
         }
         self.reset()
         return out
@@ -251,6 +324,15 @@ class HttpService:
         )
         self._m_queue_depth = m.gauge(
             "admission_queue_depth", "requests waiting for an admission slot"
+        )
+        self._m_min_tier = m.gauge(
+            "admission_min_tier",
+            "lowest admitted request tier (degradation ladder cutoff)"
+        )
+        self._m_tier_shed = m.counter(
+            "admission_tier_shed_total",
+            "requests shed for being below the degradation tier cutoff",
+            ["endpoint"],
         )
         self._m_active = m.gauge(
             "admission_active", "requests holding an admission slot"
@@ -331,19 +413,47 @@ class HttpService:
                 parent = upstream.span_id
         return Context.with_timeout(timeout_s, trace=trace), parent
 
+    @staticmethod
+    def _request_tier(request: web.Request, body: Optional[dict] = None) -> int:
+        """Priority tier from the ``X-Request-Tier`` header (or a ``tier``
+        body field); malformed values get the default tier, not an error."""
+        raw = request.headers.get(TIER_HEADER)
+        if raw is None and body is not None:
+            raw = body.get("tier")
+        if raw is None:
+            return DEFAULT_TIER
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            return DEFAULT_TIER
+
+    def apply_degradation(self, actions: dict) -> None:
+        """Apply the planner's degradation orders to admission (the
+        ``shed_low_tier`` ladder step); spec/chunk clamps are worker-side."""
+        min_tier = int(actions.get("min_tier") or 0)
+        if self.admission is not None:
+            self.admission.min_tier = min_tier
+        self._m_min_tier.set(min_tier)
+        log.info("degradation orders applied: min_tier=%d level=%s",
+                 min_tier, actions.get("level"))
+
     async def _admit(
-        self, endpoint: str, model: str, ctx: Context
+        self, endpoint: str, model: str, ctx: Context,
+        tier: int = DEFAULT_TIER,
     ) -> Optional[web.Response]:
         """Acquire an admission slot; a Response means the request was shed."""
         if self.admission is None:
             return None
         span = tracing.get_tracer().start_span("frontend.admission", ctx)
+        pre_tier_shed = self.admission.num_tier_shed
         try:
-            await self.admission.acquire(deadline=ctx.deadline)
+            await self.admission.acquire(deadline=ctx.deadline, tier=tier)
         except AdmissionError as e:
             span.set_status("error", f"shed:{e.status}")
             span.end()
             self._m_shed.labels(endpoint=endpoint, status=str(e.status)).inc()
+            if self.admission.num_tier_shed > pre_tier_shed:
+                self._m_tier_shed.labels(endpoint=endpoint).inc()
             self._m_requests.labels(
                 model=model, endpoint=endpoint, status=str(e.status)
             ).inc()
@@ -424,7 +534,8 @@ class HttpService:
                 model, endpoint,
             )
         ctx, _upstream = self._request_ctx(request)
-        shed = await self._admit(endpoint, model, ctx)
+        shed = await self._admit(endpoint, model, ctx,
+                                 tier=self._request_tier(request, body))
         if shed is not None:
             return shed
         self._m_inflight.labels(model=model).inc()
@@ -479,7 +590,8 @@ class HttpService:
             return self._err(400, f"model {model!r} does not support chat",
                              model, endpoint)
         ctx, _upstream = self._request_ctx(request)
-        shed = await self._admit(endpoint, model, ctx)
+        shed = await self._admit(endpoint, model, ctx,
+                                 tier=self._request_tier(request, body))
         if shed is not None:
             return shed
         rid = oai.response_id()
@@ -588,7 +700,8 @@ class HttpService:
             "frontend.request", trace=ctx.trace, parent_span_id=upstream,
             attrs={"model": model, "endpoint": endpoint}, root=True,
         )
-        shed = await self._admit(endpoint, model, ctx)
+        shed = await self._admit(endpoint, model, ctx,
+                                 tier=self._request_tier(request, body))
         if shed is not None:
             root.set_status("error", f"shed:{shed.status}")
             root.end()
@@ -686,14 +799,12 @@ class HttpService:
                 now = time.monotonic()
                 if first:
                     self._m_ttft.labels(model=model).observe(now - t0)
-                    ws.ttft_sum += now - t0
-                    ws.ttft_count += 1
+                    ws.record_ttft(now - t0)
                     ws.isl_sum += out.num_prompt_tokens
                     first = False
                 elif prev is not None:
                     self._m_itl.labels(model=model).observe(now - prev)
-                    ws.itl_sum += now - prev
-                    ws.itl_count += 1
+                    ws.record_itl(now - prev)
                 prev = now
                 # token count, not chunk count (a chunk can carry several
                 # token ids, or none during stop-string holdback)

@@ -19,6 +19,7 @@ from typing import Callable, Dict
 
 import msgpack
 
+from .planner.connector import planner_events_subject
 from .router.kv_router import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
 from .runtime.component import DistributedRuntime
 from .runtime.system_server import SystemServer
@@ -70,21 +71,44 @@ class MetricsAggregator:
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
+        # planner control-loop visibility: the degradation ladder's current
+        # level, the latest scaling targets, and every transition
+        self._g_degradation = m.gauge(
+            "planner_degradation_level",
+            "engaged degradation-ladder steps (0 = none)"
+        )
+        self._g_targets = m.gauge(
+            "planner_target_replicas",
+            "latest planner replica target", ["role"]
+        )
+        self._c_transitions = m.counter(
+            "planner_transitions_total",
+            "planner control-loop transitions", ["kind", "detail"]
+        )
         self.worker_stats: Dict[str, dict] = {}
         self._last_seen: Dict[str, float] = {}
         self._tasks = []
 
-    async def start(self) -> None:
+    async def start(self, signals_interval_s: float = 0.0) -> None:
+        """Subscribe the metric feeds; ``signals_interval_s`` > 0 also
+        publishes the aggregated planner signals (worker queue depth + spec
+        acceptance) on ``{ns}/planner_signals`` at that cadence."""
         store = self.runtime.store
         for subject, handler in (
             (self.component.event_subject(LOAD_METRICS_SUBJECT),
              self._on_stats),
             (self.component.event_subject(KV_EVENTS_SUBJECT),
              self._on_kv_event),
+            (planner_events_subject(self.component.namespace.name),
+             self._on_planner_event),
         ):
             stream = await store.subscribe(subject)
             self._tasks.append(asyncio.create_task(
                 self._pump(subject, stream, handler)
+            ))
+        if signals_interval_s > 0:
+            self._tasks.append(asyncio.create_task(
+                self._publish_signals(signals_interval_s)
             ))
 
     async def stop(self) -> None:
@@ -163,6 +187,54 @@ class MetricsAggregator:
         kind = payload.get("event", {}).get("kind", "unknown")
         self._c_events.labels(kind=kind).inc()
 
+    # ---------------------- planner control loop ------------------------
+
+    def _on_planner_event(self, event: dict) -> None:
+        kind = event.get("kind", "unknown")
+        if kind == "degradation":
+            self._g_degradation.set(event.get("level", 0))
+            self._c_transitions.labels(
+                kind="degradation",
+                detail=f"{event.get('direction')}:{event.get('step')}",
+            ).inc()
+        elif kind == "scale":
+            for role in ("prefill", "decode"):
+                if role in event:
+                    self._g_targets.labels(role=role).set(event[role])
+            self._c_transitions.labels(kind="scale", detail="targets").inc()
+
+    def queue_depth(self) -> int:
+        """Requests waiting across every live worker (the planner's
+        backlog signal)."""
+        return int(sum(s.get("num_requests_waiting", 0)
+                       for s in self.worker_stats.values()))
+
+    def spec_acceptance(self):
+        drafted = sum((s.get("spec") or {}).get("drafted", 0)
+                      for s in self.worker_stats.values())
+        accepted = sum((s.get("spec") or {}).get("accepted", 0)
+                       for s in self.worker_stats.values())
+        return accepted / drafted if drafted else None
+
+    async def _publish_signals(self, interval_s: float) -> None:
+        """The aggregator's side of the planner feed: worker-queue backlog
+        and aggregate spec acceptance, published like frontend_stats."""
+        subject = f"{self.component.namespace.name}/planner_signals"
+        while True:
+            await asyncio.sleep(interval_s)
+            self.expire_stale()
+            payload = {
+                "queue_depth": self.queue_depth(),
+                "spec_acceptance": self.spec_acceptance(),
+                "num_workers": len(self.worker_stats),
+            }
+            try:
+                await self.runtime.store.publish(
+                    subject, msgpack.packb(payload, use_bin_type=True)
+                )
+            except Exception as exc:
+                log.warning("planner signals publish failed: %s", exc)
+
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
@@ -171,6 +243,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--component", default="backend")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9090)
+    p.add_argument(
+        "--signals-interval", type=float, default=10.0,
+        help="seconds between planner_signals publishes (worker queue "
+             "depth + spec acceptance for the SLA planner; 0 disables)",
+    )
     return p.parse_args(argv)
 
 
@@ -183,7 +260,7 @@ async def run(args: argparse.Namespace) -> None:
     runtime = await DistributedRuntime.from_settings(config)
 
     agg = MetricsAggregator(runtime, args.component)
-    await agg.start()
+    await agg.start(signals_interval_s=args.signals_interval)
     server = SystemServer(metrics=runtime.metrics, host=args.host,
                           port=args.port)
     await server.start()

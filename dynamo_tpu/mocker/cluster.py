@@ -1,0 +1,727 @@
+"""Simulated P/D cluster for closed-loop planner validation
+(ref: the mocker-engine scale harness of components/backends/mocker —
+speedup-accelerated engines faithful enough for control-loop dynamics).
+
+Builds a real distributed deployment — store, per-worker
+``DistributedRuntime`` + ingress server, KV-aware router, migration — whose
+*engines* are simulated: token timing is driven by a small load model
+instead of a device. That keeps every control-plane seam real (leases,
+discovery, drains, breakers, migration carryover) while letting a hundred
+workers and thousands of requests run on one CPU in seconds.
+
+Load model:
+
+- **TTFT** = wait for a slot in a global prefill pool (capacity = live
+  prefill workers × slots) + ISL × per-token prefill cost + one decode
+  step. Prefill workers are pure capacity: flipping a worker to prefill
+  grows the pool, so the planner's prefill targets have real effect.
+- **ITL** = per-worker decode step × max(1, active/seats): a decode worker
+  running more streams than seats slows all of them, so overload shows up
+  exactly where the planner looks (itl p99).
+- Degradation orders feed back as cost scales (clamping spec_k /
+  tightening chunking cheapens decode steps) and as admission tier
+  shedding, so the ladder measurably relieves pressure before scaling.
+
+Engines emit ScriptedWorker-convention tokens (1000 + absolute position)
+so migrations and role flips are checked for byte-exact parity.
+
+``SimCluster`` implements the orchestrator's ``WorkerPool`` protocol
+(workers/spawn/stop/flip) plus ``kill`` for chaos. ``run_scenario`` closes
+the whole loop: drive bursty Poisson/diurnal arrivals with seeded chaos
+(worker kills, an optional store flap) against a live planner +
+orchestrator and report per-window SLO compliance, recovery time, parity,
+and per-tier latency percentiles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+import socket
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..frontend.service import AdmissionController, AdmissionError, percentile
+from ..llm.migration import Migration
+from ..planner.connector import VirtualConnector
+from ..planner.core import Planner, PlannerConfig, WindowMetrics
+from ..planner.degradation import DegradationConfig, DegradationWatcher
+from ..planner.interpolation import DecodeInterpolator, PrefillInterpolator
+from ..planner.orchestrator import Orchestrator
+from ..router.kv_router import KvPushRouter, KvRouter
+from ..router.scheduler import KvRouterConfig
+from ..runtime.circuit import BreakerConfig, CircuitBreakerRegistry
+from ..runtime.component import DistributedRuntime
+from ..runtime.context import Context
+from ..runtime.engine import AsyncEngine
+from ..runtime.store import StoreServer
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+
+log = get_logger("mocker.cluster")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ----------------------------- load model -------------------------------
+
+
+@dataclass
+class SimTiming:
+    """Device-time costs; wall sleeps are divided by ``speedup_ratio``
+    (ref: MockerConfig — the same acceleration knob)."""
+
+    prefill_time_per_token_s: float = 10e-3
+    decode_time_per_step_s: float = 160e-3
+    speedup_ratio: float = 20.0
+    prefill_slots_per_worker: int = 1
+    decode_seats_per_worker: int = 1
+
+    @property
+    def eff_prefill_tpt(self) -> float:
+        return self.prefill_time_per_token_s / self.speedup_ratio
+
+    @property
+    def eff_step(self) -> float:
+        return self.decode_time_per_step_s / self.speedup_ratio
+
+    def interpolators(self) -> Tuple[PrefillInterpolator, DecodeInterpolator]:
+        """The profile an SLA profiler would record for these engines —
+        ideal (uncongested) latency curves and the throughput/chip envelope
+        the planner inverts."""
+        step, tpt = self.eff_step, self.eff_prefill_tpt
+        slots = self.prefill_slots_per_worker
+        isl_grid = [8.0, 64.0, 512.0]
+        # profiled TTFT includes the first (uncongested) decode step, like a
+        # real profiler's time-to-first-token would
+        prefill = PrefillInterpolator(
+            isl=isl_grid,
+            ttft_s=[isl * tpt + step for isl in isl_grid],
+            thpt_per_chip=[slots / tpt] * len(isl_grid),
+        )
+        # conservative throughput envelope: the planner provisions headroom
+        # below the factor-1 saturation rate (1/step tokens/s/worker)
+        decode = DecodeInterpolator(
+            kv_usage=[0.2, 0.5, 0.9, 0.2, 0.5, 0.9],
+            context_length=[16.0, 16.0, 16.0, 512.0, 512.0, 512.0],
+            itl_s=[step, step * 1.5, step * 3.0] * 2,
+            thpt_per_chip=[0.5 / step, 0.65 / step, 0.8 / step] * 2,
+        )
+        return prefill, decode
+
+
+class ResizablePool:
+    """Counting pool whose capacity follows the live prefill fleet."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, capacity)
+        self._in_use = 0
+        self._cond = asyncio.Condition()
+
+    @property
+    def waiting(self) -> int:
+        return len(self._cond._waiters)  # backlog signal for the planner
+
+    async def acquire(self) -> None:
+        async with self._cond:
+            while self._in_use >= self.capacity:
+                await self._cond.wait()
+            self._in_use += 1
+
+    async def release(self) -> None:
+        async with self._cond:
+            self._in_use -= 1
+            self._cond.notify_all()
+
+    async def resize(self, capacity: int) -> None:
+        async with self._cond:
+            self.capacity = max(1, capacity)
+            self._cond.notify_all()
+
+
+class SimWorkerEngine(AsyncEngine):
+    """AsyncEngine with load-coupled timing and ScriptedWorker parity
+    tokens: position ``j`` of the stream is token ``1000 + prompt_len + j``,
+    so migrated/flipped continuations are byte-checkable."""
+
+    def __init__(self, cluster: "SimCluster"):
+        self.cluster = cluster
+        self.active = 0  # decode streams running on this worker
+
+    async def generate(self, request, context):
+        cl = self.cluster
+        t = cl.timing
+        prompt = list(request["token_ids"])
+        start = len(prompt)
+        n = int(request.get("max_tokens", 8))
+        await cl.prefill_pool.acquire()
+        try:
+            await asyncio.sleep(start * t.eff_prefill_tpt * cl.prefill_scale)
+        finally:
+            await cl.prefill_pool.release()
+        self.active += 1
+        try:
+            for i in range(n):
+                if context.is_stopped() or context.is_expired():
+                    return  # no finished marker: the client migrates
+                # the first token is scheduled ahead of the congested batch
+                # so TTFT stays a prefill signal and ITL a decode signal
+                factor = (1.0 if i == 0 else
+                          max(1.0, self.active
+                              / max(1, t.decode_seats_per_worker)))
+                await asyncio.sleep(t.eff_step * factor * cl.decode_scale)
+                if context.is_stopped() or context.is_expired():
+                    return
+                yield {
+                    "token_ids": [1000 + start + i],
+                    "finished": i == n - 1,
+                    "finish_reason": "length" if i == n - 1 else None,
+                    "num_prompt_tokens": start,
+                }
+        finally:
+            self.active -= 1
+
+
+# ------------------------------- cluster --------------------------------
+
+
+@dataclass
+class SimWorker:
+    wid: int
+    runtime: DistributedRuntime
+    engine: SimWorkerEngine
+    served: object
+    component: str
+
+
+class SimCluster:
+    """A live simulated deployment implementing the orchestrator's
+    ``WorkerPool``: every worker is a real runtime + ingress server whose
+    engine timing comes from the shared load model."""
+
+    def __init__(
+        self,
+        cfg: RuntimeConfig,
+        *,
+        namespace: str = "sim",
+        prefill_component: str = "prefill",
+        decode_component: str = "backend",
+        timing: Optional[SimTiming] = None,
+        drain_deadline_s: float = 0.15,
+    ):
+        self.cfg = cfg
+        self.namespace = namespace
+        self.prefill_component = prefill_component
+        self.decode_component = decode_component
+        self.timing = timing or SimTiming()
+        self.drain_deadline_s = drain_deadline_s
+        self.prefill_pool = ResizablePool(1)
+        # degradation feedback: cheapened decode steps while clamps hold
+        self.decode_scale = 1.0
+        self.prefill_scale = 1.0
+        self.num_kills = 0
+        self._workers: Dict[int, SimWorker] = {}
+        self._next_id = 0
+
+    # ------------------------- WorkerPool ---------------------------
+
+    def workers(self, component: str) -> List[int]:
+        return sorted(w.wid for w in self._workers.values()
+                      if w.component == component)
+
+    async def spawn(self, component: str) -> int:
+        rt = await DistributedRuntime.from_settings(self.cfg)
+        engine = SimWorkerEngine(self)
+        ep = (rt.namespace(self.namespace).component(component)
+              .endpoint("generate"))
+        served = await ep.serve_endpoint(engine, advertise_host="127.0.0.1")
+        wid = self._next_id
+        self._next_id += 1
+        self._workers[wid] = SimWorker(wid, rt, engine, served, component)
+        await self._resize_prefill()
+        return wid
+
+    async def stop(self, worker_id: int) -> None:
+        sw = self._workers.pop(worker_id)
+        await sw.served.drain_and_stop(deadline_s=self.drain_deadline_s)
+        await sw.runtime.shutdown()
+        await self._resize_prefill()
+
+    async def flip(self, worker_id: int, component: str) -> None:
+        sw = self._workers[worker_id]
+        if sw.component == component:
+            return
+        # drain off the old role: in-flight joins within the deadline,
+        # stragglers are stopped so Migration carries them to a peer
+        await sw.served.drain_and_stop(deadline_s=self.drain_deadline_s)
+        sw.served.server.draining = False
+        ep = (sw.runtime.namespace(self.namespace).component(component)
+              .endpoint("generate"))
+        sw.served = await ep.serve_endpoint(sw.engine,
+                                            advertise_host="127.0.0.1")
+        sw.component = component
+        await self._resize_prefill()
+
+    # --------------------------- chaos ------------------------------
+
+    async def kill(self, worker_id: int) -> None:
+        """Abrupt crash: in-flight streams are cut mid-frame (clients see a
+        retryable failure and migrate); the lease revocation deregisters."""
+        sw = self._workers.pop(worker_id)
+        self.num_kills += 1
+        try:
+            await sw.served.server.stop()
+        except Exception:
+            pass
+        try:
+            await sw.runtime.shutdown()
+        except Exception:
+            pass
+        await self._resize_prefill()
+
+    # ------------------------- lifecycle ----------------------------
+
+    async def start(self, n_prefill: int, n_decode: int,
+                    batch: int = 16) -> None:
+        todo = ([self.prefill_component] * n_prefill
+                + [self.decode_component] * n_decode)
+        for i in range(0, len(todo), batch):
+            await asyncio.gather(*(self.spawn(c) for c in todo[i:i + batch]))
+
+    async def shutdown(self) -> None:
+        for sw in list(self._workers.values()):
+            try:
+                await sw.served.server.stop()
+            except Exception:
+                pass
+            try:
+                await sw.runtime.shutdown()
+            except Exception:
+                pass
+        self._workers.clear()
+
+    async def _resize_prefill(self) -> None:
+        n = len(self.workers(self.prefill_component))
+        await self.prefill_pool.resize(
+            n * self.timing.prefill_slots_per_worker)
+
+    def apply_degradation(self, actions: dict) -> None:
+        """The worker-side effect of the ladder's orders: clamped spec_k
+        stops draft-verify amplification, tightened chunking stops long
+        prefills stalling decodes — both cheapen decode steps."""
+        scale = 1.0
+        if actions.get("spec_k_max") is not None:
+            scale *= 0.85
+        if actions.get("prefill_chunk_tokens_max") is not None:
+            scale *= 0.90
+        self.decode_scale = scale
+
+
+# ------------------------------ scenarios -------------------------------
+
+
+@dataclass
+class SimScenario:
+    """One closed-loop run: phases (warmup → burst+chaos → cooldown) at
+    ``window_s`` planner cadence. All randomness flows from ``seed``."""
+
+    seed: int = 0
+    n_prefill: int = 6
+    n_decode: int = 10
+    timing: SimTiming = field(default_factory=SimTiming)
+    isl: int = 32
+    osl: int = 8
+    base_rps: float = 25.0
+    burst_factor: float = 4.0
+    diurnal_amplitude: float = 0.15
+    diurnal_period_s: float = 4.0
+    warmup_s: float = 1.0
+    burst_s: float = 2.5
+    cooldown_s: float = 2.0
+    window_s: float = 0.5
+    ttft_sla_s: float = 0.15
+    itl_sla_s: float = 0.02
+    kill_fraction: float = 0.1
+    store_flap_s: float = 0.0  # >0: stop/restart the store mid-burst
+    max_chip_budget: int = 32
+    min_endpoint: int = 3
+    migration_limit: int = 8
+    max_concurrency: int = 4096
+    max_queue: int = 4096
+    tier_weights: Tuple[float, float, float] = (0.3, 0.4, 0.3)
+    spec_acceptance: float = 0.62  # synthetic aggregator signal
+    attach_aggregator: bool = True
+    engage_ratio: float = 1.5  # ladder engagement pressure threshold
+
+    @property
+    def duration_s(self) -> float:
+        return self.warmup_s + self.burst_s + self.cooldown_s
+
+    def rate(self, t: float) -> float:
+        burst = (self.burst_factor
+                 if self.warmup_s <= t < self.warmup_s + self.burst_s
+                 else 1.0)
+        diurnal = 1.0 + self.diurnal_amplitude * math.sin(
+            2 * math.pi * t / self.diurnal_period_s)
+        return self.base_rps * burst * diurnal
+
+
+def flagship_scenario(seed: int = 0) -> SimScenario:
+    """The 100+ worker configuration (slow; scripts/verify.sh planner).
+
+    Workers are slow enough (eff. 200 ms/step) that ~70 decode replicas are
+    genuinely needed at the 45 rps baseline, yet the whole cluster and a 4x
+    burst still fit one event loop. The burst's raw demand exceeds the chip
+    budget — only degradation (tier shed + clamps) plus scale-to-budget can
+    restore the SLO, which is exactly the control story under test."""
+    return SimScenario(
+        seed=seed,
+        n_prefill=32,
+        n_decode=72,
+        timing=SimTiming(prefill_time_per_token_s=20e-3,
+                         decode_time_per_step_s=4.0,
+                         speedup_ratio=20.0),
+        isl=48,
+        osl=6,
+        base_rps=45.0,
+        warmup_s=2.0,
+        burst_s=4.0,
+        cooldown_s=4.0,
+        window_s=1.0,
+        ttft_sla_s=0.6,
+        itl_sla_s=0.45,
+        store_flap_s=0.4,
+        max_chip_budget=150,
+        min_endpoint=6,
+        max_concurrency=220,
+        max_queue=300,
+        # the big fleet's overload plateaus nearer the SLA line than the
+        # compact scenario's — engage the ladder on a smaller overshoot
+        engage_ratio=1.3,
+    )
+
+
+def arrival_times(rng: random.Random, scenario: SimScenario) -> List[float]:
+    """Non-homogeneous Poisson arrivals over the scenario's rate curve."""
+    out, t = [], 0.0
+    while t < scenario.duration_s:
+        t += rng.expovariate(max(scenario.rate(t), 1e-6))
+        if t < scenario.duration_s:
+            out.append(t)
+    return out
+
+
+class _Recorder:
+    """Per-window latency reservoirs + run-level per-tier accumulation."""
+
+    RESERVOIR = 4096
+
+    def __init__(self, seed: int):
+        self._rng = random.Random(seed)
+        self.num_arrived = 0
+        self.num_shed = 0
+        self.ttft: List[float] = []
+        self.itl: List[float] = []
+        self.tiers: Dict[int, Dict[str, list]] = {}
+        self.request_slo: List[bool] = []  # per-request violation flags
+
+    def _sample(self, samples: list, v: float) -> None:
+        if len(samples) < self.RESERVOIR:
+            samples.append(v)
+        else:
+            samples[self._rng.randrange(self.RESERVOIR)] = v
+
+    def record(self, tier: int, ttft_s: float, itls: List[float],
+               violated: bool) -> None:
+        self._sample(self.ttft, ttft_s)
+        for v in itls:
+            self._sample(self.itl, v)
+        bucket = self.tiers.setdefault(tier, {"ttft": [], "itl": []})
+        self._sample(bucket["ttft"], ttft_s)
+        for v in itls:
+            self._sample(bucket["itl"], v)
+        self.request_slo.append(violated)
+
+    def drain_window(self) -> dict:
+        win = {
+            "num_arrived": self.num_arrived,
+            "num_shed": self.num_shed,
+            "num_completed": len(self.ttft),
+            "ttft_p50_s": percentile(self.ttft, 0.50),
+            "ttft_p99_s": percentile(self.ttft, 0.99),
+            "itl_p50_s": percentile(self.itl, 0.50),
+            "itl_p99_s": percentile(self.itl, 0.99),
+        }
+        self.num_arrived = 0
+        self.num_shed = 0
+        self.ttft = []
+        self.itl = []
+        return win
+
+    def tier_summary(self) -> dict:
+        return {
+            str(tier): {
+                "count": len(b["ttft"]),
+                "ttft_p50_s": percentile(b["ttft"], 0.50),
+                "ttft_p99_s": percentile(b["ttft"], 0.99),
+                "itl_p50_s": percentile(b["itl"], 0.50),
+                "itl_p99_s": percentile(b["itl"], 0.99),
+            }
+            for tier, b in sorted(self.tiers.items())
+        }
+
+
+async def run_scenario(sc: SimScenario, workdir: str) -> dict:
+    """Drive the scenario end-to-end with zero manual intervention and
+    return the trajectory report. ``workdir`` holds the store snapshot
+    (needed for the mid-burst store flap)."""
+    rng = random.Random(sc.seed)
+    port = _free_port()
+    snap = f"{workdir}/sim-store.snap"
+    stores = {"live": StoreServer("127.0.0.1", port, persist_path=snap)}
+    await stores["live"].start()
+    cfg = RuntimeConfig(
+        store_addr=f"127.0.0.1:{port}",
+        namespace="sim",
+        store_reconnect_base_s=0.05,
+        store_reconnect_cap_s=0.2,
+        store_recover_timeout_s=15.0,
+        store_reconcile_grace_s=0.5,
+    )
+    cluster = SimCluster(cfg, namespace="sim", timing=sc.timing)
+    await cluster.start(sc.n_prefill, sc.n_decode)
+
+    front = await DistributedRuntime.from_settings(cfg)
+    client = await (front.namespace("sim")
+                    .component(cluster.decode_component)
+                    .endpoint("generate").client())
+    await client.wait_for_instances(sc.n_decode, timeout_s=20.0)
+    breakers = CircuitBreakerRegistry(
+        BreakerConfig(failure_threshold=3, open_timeout_s=1.0))
+    router = KvRouter(
+        client, client.endpoint.component,
+        block_size=16, use_events=False, seed=0,
+        config=KvRouterConfig(replica_sync=False, snapshot_threshold=0),
+        breakers=breakers,
+    )
+    mig = Migration(KvPushRouter(router), migration_limit=sc.migration_limit,
+                    backoff_base_s=0.01, rng=random.Random(sc.seed))
+
+    admission = AdmissionController(sc.max_concurrency,
+                                    max_queue=sc.max_queue)
+    prefill_interp, decode_interp = sc.timing.interpolators()
+    connector = VirtualConnector(front.store, namespace="sim")
+    planner = Planner(
+        PlannerConfig(
+            ttft_sla_s=sc.ttft_sla_s,
+            itl_sla_s=sc.itl_sla_s,
+            adjustment_interval_s=sc.window_s,
+            min_endpoint=sc.min_endpoint,
+            max_chip_budget=sc.max_chip_budget,
+            predictor_order=2,
+            degradation=DegradationConfig(engage_ratio=sc.engage_ratio),
+        ),
+        prefill_interp, decode_interp, connector,
+        prefill_component=cluster.prefill_component,
+        decode_component=cluster.decode_component,
+    )
+    orchestrator = Orchestrator(
+        front.store, cluster, namespace="sim",
+        prefill_component=cluster.prefill_component,
+        decode_component=cluster.decode_component,
+        max_chip_budget=sc.max_chip_budget,
+    )
+
+    def _apply_degradation(actions: dict) -> None:
+        admission.min_tier = actions.get("min_tier") or 0
+        cluster.apply_degradation(actions)
+
+    watcher = DegradationWatcher(front.store, "sim", _apply_degradation)
+
+    aggregator = None
+    if sc.attach_aggregator:
+        from ..metrics_aggregator import MetricsAggregator
+
+        aggregator = MetricsAggregator(front, cluster.decode_component)
+        await aggregator.start()
+
+    recorder = _Recorder(sc.seed)
+    report: dict = {
+        "seed": sc.seed, "windows": [], "dropped": [],
+        "parity_failures": [], "chaos_window": None,
+    }
+    expected = [1000 + sc.isl + j for j in range(sc.osl)]
+    loop = asyncio.get_running_loop()
+
+    async def _one_request(i: int) -> None:
+        tier = rng.choices((0, 1, 2), weights=sc.tier_weights)[0]
+        # arrivals (not post-queue admissions) are the demand signal the
+        # planner provisions for — a saturated queue must not hide load
+        recorder.num_arrived += 1
+        try:
+            await admission.acquire(tier=tier)
+        except AdmissionError:
+            recorder.num_shed += 1
+            return
+        try:
+            prompt = [((i * 7 + j) % 500) + 2 for j in range(sc.isl)]
+            req = {"token_ids": prompt, "max_tokens": sc.osl}
+            t0 = loop.time()
+            first = prev = None
+            itls: List[float] = []
+            toks: List[int] = []
+            frames = []
+            async for frame in mig.generate(req,
+                                            Context(request_id=f"sim-{i}")):
+                now = loop.time()
+                if first is None:
+                    first = now - t0
+                else:
+                    itls.append(now - prev)
+                prev = now
+                toks.extend(frame["token_ids"])
+                frames.append(frame)
+            if (toks != expected or not frames
+                    or not frames[-1].get("finished")
+                    or any(f["num_prompt_tokens"] != sc.isl for f in frames)):
+                recorder.request_slo.append(True)
+                report["parity_failures"].append(
+                    {"request": i, "tokens": toks})
+                return
+            mean_itl = sum(itls) / len(itls) if itls else 0.0
+            violated = first > sc.ttft_sla_s or mean_itl > sc.itl_sla_s
+            recorder.record(tier, first, itls, violated)
+        except Exception as exc:
+            report["dropped"].append({"request": i, "error": repr(exc)})
+        finally:
+            admission.release()
+
+    async def _load() -> None:
+        t0 = loop.time()
+        tasks = []
+        for i, at in enumerate(arrival_times(random.Random(sc.seed + 1), sc)):
+            delay = t0 + at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(_one_request(i)))
+        await asyncio.gather(*tasks)
+
+    async def _chaos() -> None:
+        # kills land just after the burst begins
+        await asyncio.sleep(sc.warmup_s + sc.window_s / 2)
+        decode = cluster.workers(cluster.decode_component)
+        n_kill = max(1, math.ceil(sc.kill_fraction * len(decode)))
+        victims = random.Random(sc.seed + 2).sample(decode, n_kill)
+        report["chaos_window"] = len(report["windows"])
+        report["killed"] = victims
+        for wid in victims:
+            await cluster.kill(wid)
+        if sc.store_flap_s > 0:
+            await asyncio.sleep(sc.window_s)
+            await stores["live"].stop()
+            await asyncio.sleep(sc.store_flap_s)
+            stores["live"] = StoreServer("127.0.0.1", port,
+                                         persist_path=snap)
+            await stores["live"].start()
+
+    load_task = asyncio.create_task(_load())
+    chaos_task = asyncio.create_task(_chaos())
+
+    # ------------- the control loop under test (no human in it) ----------
+    n_windows = int(math.ceil(sc.duration_s / sc.window_s)) + 2
+    for _w in range(n_windows):
+        await asyncio.sleep(sc.window_s)
+        win = recorder.drain_window()
+        metrics = WindowMetrics(
+            num_requests=win["num_arrived"],
+            isl_avg=sc.isl, osl_avg=sc.osl,
+            ttft_p50_s=win["ttft_p50_s"], ttft_p99_s=win["ttft_p99_s"],
+            itl_p50_s=win["itl_p50_s"], itl_p99_s=win["itl_p99_s"],
+            ttft_avg_s=win["ttft_p50_s"], itl_avg_s=win["itl_p50_s"],
+            # prefill-attributable backlog only: the admission queue is
+            # decode pressure and already shows in the ITL correction
+            queue_depth=cluster.prefill_pool.waiting,
+            breaker_open=sum(1 for s in breakers.states().values()
+                             if s != "closed"),
+            spec_acceptance=sc.spec_acceptance,
+        )
+        planner.observe(metrics)
+        try:
+            await planner.make_adjustments()
+            await watcher.poll_once()
+            await orchestrator.reconcile()
+        except Exception as exc:  # store flap: stale orders, next window wins
+            log.warning("control window degraded to staleness: %s", exc)
+        win.update({
+            "compliant": (
+                win["num_completed"] > 0
+                and win["ttft_p99_s"] <= sc.ttft_sla_s
+                and win["itl_p99_s"] <= sc.itl_sla_s
+            ),
+            "degradation_level": planner.ladder.level,
+            "targets": planner.last_targets,
+            "live_prefill": len(cluster.workers(cluster.prefill_component)),
+            "live_decode": len(cluster.workers(cluster.decode_component)),
+            "breaker_open": metrics.breaker_open,
+        })
+        report["windows"].append(win)
+
+    await asyncio.wait_for(load_task, timeout=60.0)
+    await chaos_task
+    if aggregator is not None:
+        await asyncio.sleep(0.2)  # let the last planner events land
+        report["metrics_text"] = front.metrics.render().decode()
+        await aggregator.stop()
+
+    # ------------------------------ report -------------------------------
+    # recovery is counted from the first *visible* SLO breach at/after the
+    # chaos window (the kill lands mid-window, so the window it falls in may
+    # still close compliant) to the first compliant window after it; idle
+    # tail windows carry no signal and cannot open a breach
+    cw = report["chaos_window"]
+    recovery = None
+    if cw is not None:
+        wins = report["windows"]
+        breach = next(
+            (i for i in range(cw, len(wins))
+             if (wins[i]["num_arrived"] or wins[i]["num_completed"])
+             and not wins[i]["compliant"]),
+            None,
+        )
+        if breach is None:
+            recovery = 0
+        else:
+            for idx in range(breach, len(wins)):
+                if wins[idx]["compliant"]:
+                    recovery = idx - breach
+                    break
+    report.update({
+        "recovery_windows": recovery,
+        "num_requests": len(recorder.request_slo),
+        "num_shed_total": admission.num_shed,
+        "slo_violation_rate": (
+            sum(recorder.request_slo) / len(recorder.request_slo)
+            if recorder.request_slo else None),
+        "tiers": recorder.tier_summary(),
+        "degradation_transitions": list(planner.ladder.transitions),
+        "degradation_max_level": max(
+            (w["degradation_level"] for w in report["windows"]), default=0),
+        "orchestrator": {
+            "flips": orchestrator.stats.num_flips,
+            "spawns": orchestrator.stats.num_spawns,
+            "stops": orchestrator.stats.num_stops,
+        },
+        "num_kills": cluster.num_kills,
+    })
+
+    await router.stop()
+    await client.stop()
+    await front.shutdown()
+    await cluster.shutdown()
+    await stores["live"].stop()
+    return report

@@ -1,20 +1,29 @@
 """SLA-based autoscaling planner (ref: components/planner — planner_core.py,
 perf_interpolation.py, load_predictor.py, virtual_connector.py).
 
-Observes frontend/worker metrics, predicts the next window's load, converts
-it into prefill/decode replica counts via pre-profiled perf interpolation,
-and emits scaling decisions through a connector (store-backed virtual
-connector here; a k8s connector is the deploy-layer analog).
+Observes frontend/worker metrics (tail percentiles, queue depth, breaker
+states, spec acceptance), orders graceful degradation under pressure,
+predicts the next window's load, converts it into prefill/decode replica
+counts via pre-profiled perf interpolation, and emits scaling decisions
+through a connector (store-backed virtual connector here; a k8s connector
+is the deploy-layer analog). The :class:`Orchestrator` realises the intent
+against a live worker pool — role flips first, spawns/stops for the rest.
 """
 
-from .connector import VirtualConnector
+from .connector import CallbackConnector, VirtualConnector
 from .core import Planner, PlannerConfig, WindowMetrics
+from .degradation import (
+    DegradationConfig, DegradationLadder, DegradationWatcher, STEPS,
+)
 from .interpolation import DecodeInterpolator, PrefillInterpolator
+from .orchestrator import Orchestrator, WorkerPool
 from .predictors import ARPredictor, ConstantPredictor, MovingAveragePredictor
 
 __all__ = [
     "Planner", "PlannerConfig", "WindowMetrics",
     "PrefillInterpolator", "DecodeInterpolator",
     "ConstantPredictor", "MovingAveragePredictor", "ARPredictor",
-    "VirtualConnector",
+    "VirtualConnector", "CallbackConnector",
+    "DegradationConfig", "DegradationLadder", "DegradationWatcher", "STEPS",
+    "Orchestrator", "WorkerPool",
 ]

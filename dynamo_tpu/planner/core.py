@@ -3,11 +3,17 @@
 observe_metrics:193, predict_load:240, _compute_replica_requirements:259).
 
 Every adjustment interval the planner:
-1. observes the window's request rate, mean ISL/OSL, and measured TTFT/ITL;
+1. observes the window's request rate, mean ISL/OSL, and measured TTFT/ITL
+   (tail-aware: p99 when the frontend publishes percentiles, average as the
+   forward-compat fallback) plus the live pressure signals the serving path
+   exposes — admission/worker queue depth, router breaker states, spec
+   acceptance;
 2. updates correction factors = measured latency / interpolated latency
    (queueing and interference the offline profile can't see);
-3. predicts next-window load with per-signal predictors;
-4. converts predicted load into prefill/decode replica counts using the
+3. orders graceful degradation (shed → clamp spec_k → tighten chunking)
+   while the SLO overshoot is severe, releasing in reverse as it falls;
+4. predicts next-window load with per-signal predictors;
+5. converts predicted load into prefill/decode replica counts using the
    profiled perf curves, clamps to the chip budget, and emits the targets
    through the connector.
 """
@@ -19,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..utils.logging import get_logger
+from .degradation import DegradationConfig, DegradationLadder
 from .interpolation import DecodeInterpolator, PrefillInterpolator
 from .predictors import ARPredictor
 
@@ -27,18 +34,48 @@ log = get_logger("planner")
 
 @dataclass
 class WindowMetrics:
-    """One adjustment window's observed aggregates."""
+    """One adjustment window's observed aggregates.
+
+    The percentile fields are optional: pre-PR-7 frontends publish only the
+    averages and the planner keeps working on those. ``queue_depth`` is the
+    sum of requests waiting anywhere (admission queue + worker queues),
+    ``breaker_open`` counts router breakers not currently closed, and
+    ``spec_acceptance`` is the aggregate draft acceptance rate (None when
+    speculative decoding is off)."""
 
     num_requests: float
     isl_avg: float
     osl_avg: float
     ttft_avg_s: Optional[float] = None
     itl_avg_s: Optional[float] = None
+    ttft_p50_s: Optional[float] = None
+    ttft_p99_s: Optional[float] = None
+    itl_p50_s: Optional[float] = None
+    itl_p99_s: Optional[float] = None
+    queue_depth: float = 0.0
+    breaker_open: int = 0
+    spec_acceptance: Optional[float] = None
 
     @property
     def is_valid(self) -> bool:
         vals = [self.num_requests, self.isl_avg, self.osl_avg]
         return all(v is not None and v == v and v > 0 for v in vals)
+
+    def ttft_signal(self, quantile: str = "p99") -> Optional[float]:
+        """The TTFT the SLA is judged against: the requested percentile when
+        published, else the average (old frontends)."""
+        if quantile == "p99" and self.ttft_p99_s is not None:
+            return self.ttft_p99_s
+        if quantile == "p50" and self.ttft_p50_s is not None:
+            return self.ttft_p50_s
+        return self.ttft_avg_s
+
+    def itl_signal(self, quantile: str = "p99") -> Optional[float]:
+        if quantile == "p99" and self.itl_p99_s is not None:
+            return self.itl_p99_s
+        if quantile == "p50" and self.itl_p50_s is not None:
+            return self.itl_p50_s
+        return self.itl_avg_s
 
 
 @dataclass
@@ -51,6 +88,18 @@ class PlannerConfig:
     min_endpoint: int = 1
     max_chip_budget: int = 64
     predictor_order: int = 4
+    # which latency statistic the SLA is enforced on ("p99" | "p50" | "avg")
+    sla_quantile: str = "p99"
+    # fold the queue backlog into predicted load (a standing queue is demand
+    # the arrival rate alone does not show)
+    queue_depth_weight: float = 1.0
+    # add one decode replica per open breaker: a tripped worker serves
+    # nothing, so intent must cover the hole until it heals
+    compensate_breakers: bool = True
+    # graceful degradation before scaling; None disables the ladder
+    degradation: Optional[DegradationConfig] = field(
+        default_factory=DegradationConfig
+    )
 
 
 class Planner:
@@ -76,25 +125,48 @@ class Planner:
         self.p_correction = 1.0
         self.d_correction = 1.0
         self.last_targets = (config.min_endpoint, config.min_endpoint)
+        self.last_window: Optional[WindowMetrics] = None
+        self.ladder = (DegradationLadder(config.degradation)
+                       if config.degradation is not None else None)
 
     # ------------------------- observation -----------------------------
 
     def observe(self, m: WindowMetrics) -> None:
         if not m.is_valid:
             return
+        self.last_window = m
         self._pred_req.observe(m.num_requests)
         self._pred_isl.observe(m.isl_avg)
         self._pred_osl.observe(m.osl_avg)
-        if m.ttft_avg_s:
+        q = self.config.sla_quantile
+        ttft = m.ttft_signal(q)
+        if ttft:
             expect = self.prefill.interpolate_ttft(m.isl_avg)
             if expect > 0:
-                self.p_correction = m.ttft_avg_s / expect
-        if m.itl_avg_s:
+                self.p_correction = ttft / expect
+        itl = m.itl_signal(q)
+        if itl:
             expect = self.decode.interpolate_itl(
                 0.5, m.isl_avg + m.osl_avg / 2
             )
             if expect > 0:
-                self.d_correction = m.itl_avg_s / expect
+                self.d_correction = itl / expect
+
+    def pressure(self) -> Optional[float]:
+        """Worst SLO overshoot ratio in the last window (1.0 = exactly at
+        SLA); None without a latency observation to judge."""
+        m = self.last_window
+        if m is None:
+            return None
+        q = self.config.sla_quantile
+        ratios = []
+        ttft = m.ttft_signal(q)
+        if ttft is not None and self.config.ttft_sla_s > 0:
+            ratios.append(ttft / self.config.ttft_sla_s)
+        itl = m.itl_signal(q)
+        if itl is not None and self.config.itl_sla_s > 0:
+            ratios.append(itl / self.config.itl_sla_s)
+        return max(ratios) if ratios else None
 
     # ------------------------- planning --------------------------------
 
@@ -126,6 +198,18 @@ class Planner:
             decode_tput / max(best_tput * cfg.decode_engine_num_chips, 1e-9)
         )
 
+        # live signals: a standing backlog is unserved demand on top of the
+        # arrival rate, and an open breaker is capacity that exists on paper
+        # only — both demand replicas the rate×latency math can't see
+        m = self.last_window
+        if m is not None:
+            if m.queue_depth > 0 and num_req > 0:
+                boost = 1.0 + (cfg.queue_depth_weight * m.queue_depth
+                               / max(num_req, 1.0))
+                num_p = math.ceil(num_p * min(boost, 4.0))
+            if cfg.compensate_breakers and m.breaker_open > 0:
+                num_d += int(m.breaker_open)
+
         num_p = max(num_p, cfg.min_endpoint)
         num_d = max(num_d, cfg.min_endpoint)
 
@@ -143,9 +227,31 @@ class Planner:
                         num_p, num_d)
         return num_p, num_d
 
+    async def _order_degradation(self) -> None:
+        """One ladder move per window, pushed through the connector (which
+        skips unchanged orders) and broadcast for the aggregator's gauges."""
+        if self.ladder is None:
+            return
+        pressure = self.pressure()
+        if pressure is None:
+            return
+        transition = self.ladder.update(pressure)
+        actions = self.ladder.actions()
+        if hasattr(self.connector, "set_degradation"):
+            await self.connector.set_degradation(actions)
+        if transition is not None and hasattr(self.connector,
+                                              "publish_event"):
+            direction, step = transition
+            await self.connector.publish_event({
+                "kind": "degradation", "direction": direction, "step": step,
+                "level": self.ladder.level, "pressure": pressure,
+            })
+
     async def make_adjustments(self) -> Optional[tuple]:
-        """Predict next window, emit targets. Returns (num_p, num_d) or
-        None when there is no traffic history yet."""
+        """Order degradation for the current pressure, then predict the next
+        window and emit replica targets. Returns (num_p, num_d) or None when
+        there is no traffic history yet."""
+        await self._order_degradation()
         req = self._pred_req.predict()
         isl = self._pred_isl.predict()
         osl = self._pred_osl.predict()
@@ -157,6 +263,10 @@ class Planner:
                      "(req=%.1f isl=%.0f osl=%.0f pcorr=%.2f dcorr=%.2f)",
                      num_p, num_d, req, isl, osl,
                      self.p_correction, self.d_correction)
+            if hasattr(self.connector, "publish_event"):
+                await self.connector.publish_event({
+                    "kind": "scale", "prefill": num_p, "decode": num_d,
+                })
         await self.connector.scale(self.prefill_component, num_p)
         await self.connector.scale(self.decode_component, num_d)
         self.last_targets = (num_p, num_d)

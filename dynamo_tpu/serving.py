@@ -121,6 +121,27 @@ async def serve_engine(
                 target, lambda: health.status(target)
             )
 
+    # the planner's degradation ladder can clamp spec_k /
+    # prefill_chunk_tokens cluster-wide; opt-in per worker
+    # (DYNTPU_PLANNER_APPLY_DEGRADATION) because mutating a live engine
+    # config is a behavior change operators must choose
+    if runtime.config.planner_apply_degradation:
+        from .planner.degradation import (
+            DegradationWatcher, apply_engine_clamps,
+        )
+
+        originals: dict = {}
+
+        def _apply(actions: dict) -> None:
+            changed = apply_engine_clamps(eng_cfg, actions, originals)
+            if changed:
+                log.info("degradation orders applied to engine: %s", changed)
+
+        served.degradation_watcher = DegradationWatcher(
+            runtime.store, runtime.namespace().name, _apply
+        )
+        served.degradation_watcher.start()
+
     if opts.mm_handler is not None:
         mm_ep = (runtime.namespace().component(opts.component)
                  .endpoint("encode"))
@@ -179,6 +200,9 @@ async def run_until_shutdown(
         health = getattr(served, "health_manager", None)
         if health is not None:
             await health.stop()
+        degradation = getattr(served, "degradation_watcher", None)
+        if degradation is not None:
+            await degradation.stop()
         await served.drain_and_stop(
             deadline_s=runtime.config.drain_timeout_s
         )

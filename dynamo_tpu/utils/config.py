@@ -104,6 +104,20 @@ class RuntimeConfig:
     # running decodes (0 = whole-bucket prefill); see
     # EngineConfig.prefill_chunk_tokens
     prefill_chunk_tokens: int = 0
+    # -- SLA planner (python -m dynamo_tpu.planner) --
+    # latency statistic the SLAs are enforced on: "p99" | "p50" | "avg"
+    planner_sla_quantile: str = "p99"
+    # graceful-degradation ladder (shed -> clamp spec_k -> tighten
+    # chunking) ordered before scaling; see planner/degradation.py
+    planner_degradation_enabled: bool = True
+    planner_engage_ratio: float = 1.5
+    planner_release_ratio: float = 1.0
+    planner_shed_tier: int = 1
+    planner_spec_k_clamp: int = 1
+    planner_chunk_clamp_tokens: int = 256
+    # workers poll planner/{ns}/degradation and clamp their engine knobs
+    # when enabled (frontends always apply tier shedding)
+    planner_apply_degradation: bool = False
 
     @staticmethod
     def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
@@ -180,6 +194,33 @@ class RuntimeConfig:
         )
         cfg.prefill_chunk_tokens = env_int(
             ENV_PREFIX + "PREFILL_CHUNK_TOKENS", cfg.prefill_chunk_tokens
+        )
+        cfg.planner_sla_quantile = env_str(
+            ENV_PREFIX + "PLANNER_SLA_QUANTILE", cfg.planner_sla_quantile
+        )
+        cfg.planner_degradation_enabled = env_flag(
+            ENV_PREFIX + "PLANNER_DEGRADATION_ENABLED",
+            cfg.planner_degradation_enabled,
+        )
+        cfg.planner_engage_ratio = env_float(
+            ENV_PREFIX + "PLANNER_ENGAGE_RATIO", cfg.planner_engage_ratio
+        )
+        cfg.planner_release_ratio = env_float(
+            ENV_PREFIX + "PLANNER_RELEASE_RATIO", cfg.planner_release_ratio
+        )
+        cfg.planner_shed_tier = env_int(
+            ENV_PREFIX + "PLANNER_SHED_TIER", cfg.planner_shed_tier
+        )
+        cfg.planner_spec_k_clamp = env_int(
+            ENV_PREFIX + "PLANNER_SPEC_K_CLAMP", cfg.planner_spec_k_clamp
+        )
+        cfg.planner_chunk_clamp_tokens = env_int(
+            ENV_PREFIX + "PLANNER_CHUNK_CLAMP_TOKENS",
+            cfg.planner_chunk_clamp_tokens,
+        )
+        cfg.planner_apply_degradation = env_flag(
+            ENV_PREFIX + "PLANNER_APPLY_DEGRADATION",
+            cfg.planner_apply_degradation,
         )
         return cfg
 

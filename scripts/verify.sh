@@ -10,6 +10,9 @@
 #   scripts/verify.sh spec        # speculative-decoding parity + accounting
 #   scripts/verify.sh kernel      # ragged paged-attention interpret-mode
 #                                 # parity suite (CPU, no TPU needed)
+#   scripts/verify.sh planner     # closed-loop planner suite incl. the
+#                                 # 100+-worker sim sweep; echoes the repro
+#                                 # seed (DYNTPU_PLANNER_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -32,6 +35,23 @@ fi
 if [ "${1:-}" = "resilience" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'resilience or chaos' -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "planner" ]; then
+    set -o pipefail
+    rm -f /tmp/_planner.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m planner \
+        -p no:cacheprovider 2>&1 | tee /tmp/_planner.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every planner test prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'PLANNER_SEED=[0-9]+' /tmp/_planner.log | sort -u | tr '\n' ' ')
+        echo "planner sweep FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh planner"
+        done
+    fi
+    exit $rc
 fi
 
 if [ "${1:-}" = "chaos" ]; then

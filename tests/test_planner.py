@@ -5,6 +5,9 @@ tests/planner/test_replica_calculation.py (load up → scale up; SLA met →
 hold; budget clamp) against our own profile curves.
 """
 
+import json
+import math
+
 import numpy as np
 import pytest
 
@@ -14,6 +17,11 @@ from dynamo_tpu.planner import (
     WindowMetrics,
 )
 from dynamo_tpu.planner.connector import CallbackConnector
+from dynamo_tpu.planner.degradation import (
+    NO_DEGRADATION, STEPS, DegradationConfig, DegradationLadder,
+    DegradationWatcher, apply_engine_clamps,
+)
+from dynamo_tpu.planner.orchestrator import Orchestrator
 
 pytestmark = pytest.mark.anyio
 
@@ -64,6 +72,29 @@ def test_ar_predictor_tracks_trend():
         p.observe(10.0 + 2.0 * t)
     # one-step-ahead of a linear ramp should continue the ramp
     assert p.predict() == pytest.approx(50.0, rel=0.1)
+
+
+def test_ar_predictor_drops_nan_and_empty_windows():
+    """Regression: an empty adjustment window (None) or a store-outage NaN
+    used to enter the history and poison every later lstsq fit."""
+    p = ARPredictor(order=2, history=32)
+    for t in range(10):
+        p.observe(10.0 + 2.0 * t)  # 10..28
+        p.observe(float("nan"))
+        p.observe(None)
+    p.observe(float("inf"))
+    assert p.num_dropped == 21
+    pred = p.predict()
+    assert pred is not None and math.isfinite(pred)
+    assert pred == pytest.approx(30.0, rel=0.15)  # the ramp continues
+
+
+def test_ar_predictor_all_invalid_predicts_none():
+    p = ARPredictor(order=2)
+    for v in (None, float("nan"), float("-inf"), "bogus"):
+        p.observe(v)
+    assert p.predict() is None
+    assert p.num_dropped == 4
 
 
 # ------------------------- interpolation ----------------------------------
@@ -166,6 +197,206 @@ async def _connector_roundtrip(store_client):
     assert await conn.read_target("backend") == 3
 
 
+async def test_virtual_connector_idempotent_across_restart():
+    """Unchanged targets are not re-put (no decision ID burned) and
+    decision_count survives a planner restart via the store."""
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    client = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        conn = VirtualConnector(client, namespace="ns2")
+        await conn.scale("backend", 5)
+        await conn.scale("prefill", 2)
+        assert conn.decision_count == 2
+        raw = await client.get("planner/ns2/target/backend")
+        await conn.scale("backend", 5)  # redundant: skipped
+        assert conn.decision_count == 2
+        assert await client.get("planner/ns2/target/backend") == raw
+
+        # a fresh incarnation restores both the counter and the last targets
+        conn2 = VirtualConnector(client, namespace="ns2")
+        await conn2.scale("backend", 5)  # still redundant after restart
+        assert conn2.decision_count == 2
+        assert await client.get("planner/ns2/target/backend") == raw
+        await conn2.scale("backend", 6)
+        assert conn2.decision_count == 3
+        assert json.loads(
+            await client.get("planner/ns2/target/backend"))["decision"] == 3
+    finally:
+        await client.close()
+        await server.stop()
+
+
+# ---------------------- percentile signals + pressure ----------------------
+
+
+def test_window_metrics_quantile_signals_and_fallback():
+    m = WindowMetrics(num_requests=10, isl_avg=100, osl_avg=10,
+                      ttft_avg_s=0.1, itl_avg_s=0.01,
+                      ttft_p50_s=0.2, ttft_p99_s=0.9,
+                      itl_p50_s=0.02, itl_p99_s=0.08)
+    assert m.ttft_signal("p99") == 0.9
+    assert m.ttft_signal("p50") == 0.2
+    assert m.itl_signal("p99") == 0.08
+    # pre-percentile frontends: the average keeps the planner working
+    legacy = WindowMetrics(num_requests=10, isl_avg=100, osl_avg=10,
+                           ttft_avg_s=0.1, itl_avg_s=0.01)
+    assert legacy.ttft_signal("p99") == 0.1
+    assert legacy.itl_signal("p50") == 0.01
+
+
+def test_pressure_is_worst_overshoot_ratio():
+    planner = _planner()  # ttft_sla 0.5, itl_sla 0.05
+    assert planner.pressure() is None
+    planner.observe(WindowMetrics(
+        num_requests=10, isl_avg=1024, osl_avg=128,
+        ttft_p99_s=1.0, itl_p99_s=0.05,
+        ttft_avg_s=0.2, itl_avg_s=0.02,
+    ))
+    assert planner.pressure() == pytest.approx(2.0)  # ttft 2x > itl 1x
+
+
+def test_queue_and_breaker_signals_raise_targets():
+    planner = _planner()
+    base_p, base_d = planner.compute_replicas(50, 1024, 128)
+    planner.observe(WindowMetrics(
+        num_requests=50, isl_avg=1024, osl_avg=128,
+        queue_depth=100, breaker_open=2,
+    ))
+    p, d = planner.compute_replicas(50, 1024, 128)
+    assert p > base_p  # standing backlog boosts prefill (capped at 4x)
+    assert d == base_d + 2  # one decode replica per open breaker
+
+
+# ------------------------- degradation ladder -----------------------------
+
+
+def test_degradation_ladder_engages_and_releases_in_order():
+    ladder = DegradationLadder(DegradationConfig())
+    assert ladder.update(2.0) == ("engage", "shed_low_tier")
+    assert ladder.update(1.2) is None  # hysteresis band: hold
+    assert ladder.update(2.0) == ("engage", "clamp_spec_k")
+    assert ladder.update(2.0) == ("engage", "tighten_chunking")
+    assert ladder.update(3.0) is None  # ladder exhausted
+    assert ladder.level == 3 and ladder.engaged == STEPS
+    acts = ladder.actions()
+    assert acts["min_tier"] == 1
+    assert acts["spec_k_max"] == 1
+    assert acts["prefill_chunk_tokens_max"] == 256
+    # releases strictly reverse, one per window
+    assert ladder.update(0.5) == ("release", "tighten_chunking")
+    assert ladder.update(0.5) == ("release", "clamp_spec_k")
+    assert ladder.update(0.5) == ("release", "shed_low_tier")
+    assert ladder.update(0.5) is None
+    assert ladder.level == 0
+    assert ladder.actions() == dict(NO_DEGRADATION)
+
+
+def test_apply_engine_clamps_and_restore():
+    class Cfg:
+        spec_k = 4
+        prefill_chunk_tokens = 0  # whole-bucket prefill
+
+    cfg, originals = Cfg(), {}
+    changed = apply_engine_clamps(
+        cfg, {"spec_k_max": 1, "prefill_chunk_tokens_max": 256}, originals)
+    assert changed == {"spec_k": 1, "prefill_chunk_tokens": 256}
+    # release restores the exact pre-clamp values (incl. chunking's 0)
+    changed = apply_engine_clamps(cfg, NO_DEGRADATION, originals)
+    assert changed == {"spec_k": 4, "prefill_chunk_tokens": 0}
+    assert cfg.spec_k == 4 and cfg.prefill_chunk_tokens == 0
+    assert originals == {}
+
+
+class _FakeStore:
+    def __init__(self):
+        self.data = {}
+
+    async def get(self, key):
+        return self.data.get(key)
+
+    async def put(self, key, value):
+        self.data[key] = value
+
+
+async def test_degradation_watcher_fires_on_change_only():
+    store, seen = _FakeStore(), []
+    watcher = DegradationWatcher(store, "ns", seen.append)
+    await watcher.poll_once()
+    assert seen[-1]["level"] == 0  # absent key = no degradation
+    await watcher.poll_once()
+    assert len(seen) == 1  # unchanged: no callback
+    store.data[watcher.key] = json.dumps({
+        "level": 1, "steps": ["shed_low_tier"], "min_tier": 1,
+        "spec_k_max": None, "prefill_chunk_tokens_max": None, "ts": 1.0,
+    }).encode()
+    await watcher.poll_once()
+    assert len(seen) == 2
+    assert seen[-1]["min_tier"] == 1
+    assert "ts" not in seen[-1]  # timestamp churn must not refire orders
+
+
+# ----------------------------- orchestrator -------------------------------
+
+
+class _FakePool:
+    def __init__(self, prefill, decode):
+        self._w = {"prefill": list(prefill), "backend": list(decode)}
+        self._next = 100
+
+    def workers(self, component):
+        return sorted(self._w[component])
+
+    async def spawn(self, component):
+        self._next += 1
+        self._w[component].append(self._next)
+        return self._next
+
+    async def stop(self, wid):
+        for ws in self._w.values():
+            if wid in ws:
+                ws.remove(wid)
+
+    async def flip(self, wid, component):
+        await self.stop(wid)
+        self._w[component].append(wid)
+
+
+async def _put_targets(store, prefill, decode):
+    for comp, n in (("prefill", prefill), ("backend", decode)):
+        await store.put(f"planner/ns/target/{comp}",
+                        json.dumps({"replicas": n}).encode())
+
+
+async def test_orchestrator_prefers_flips_over_stop_plus_spawn():
+    store = _FakeStore()
+    pool = _FakePool(prefill=[1, 2, 3], decode=[4, 5])
+    orch = Orchestrator(store, pool, namespace="ns", max_chip_budget=10)
+    await _put_targets(store, prefill=1, decode=4)
+    moves = await orch.reconcile()
+    assert moves == {"flips": 2, "spawns": 0, "stops": 0}
+    assert len(pool.workers("prefill")) == 1
+    assert len(pool.workers("backend")) == 4
+    # the donor's newest workers flipped; the oldest kept its role
+    assert pool.workers("prefill") == [1]
+    # converged: the next cycle is a no-op
+    assert await orch.reconcile() == {"flips": 0, "spawns": 0, "stops": 0}
+
+
+async def test_orchestrator_reclamps_to_budget():
+    store = _FakeStore()
+    pool = _FakePool(prefill=[1], decode=[2])
+    orch = Orchestrator(store, pool, namespace="ns", max_chip_budget=10)
+    # a stale/malformed record beyond budget must not be realised as-is
+    await _put_targets(store, prefill=20, decode=20)
+    moves = await orch.reconcile()
+    assert moves["flips"] == 0
+    total = len(pool.workers("prefill")) + len(pool.workers("backend"))
+    assert total <= 10
+
+
 def test_frontend_window_stats_drain():
     from dynamo_tpu.frontend.service import WindowStats
 
@@ -182,3 +413,19 @@ def test_frontend_window_stats_drain():
     assert win["itl_avg_s"] == pytest.approx(0.02)
     # drained: next window starts clean
     assert ws.drain()["num_requests"] == 0
+
+
+def test_frontend_window_stats_percentiles():
+    from dynamo_tpu.frontend.service import WindowStats
+
+    ws = WindowStats()
+    for v in range(1, 101):  # 10ms..1s
+        ws.record_ttft(v / 100.0)
+        ws.record_itl(v / 1000.0)
+    win = ws.drain()
+    assert win["ttft_p50_s"] == pytest.approx(0.5, rel=0.02)
+    assert win["ttft_p99_s"] == pytest.approx(1.0, rel=0.02)
+    assert win["itl_p50_s"] == pytest.approx(0.05, rel=0.02)
+    assert win["itl_p99_s"] == pytest.approx(0.1, rel=0.02)
+    # drained: percentiles reset with the window
+    assert ws.drain()["ttft_p99_s"] is None
