@@ -1,0 +1,36 @@
+"""dynalint — project-specific static analysis for the JAX/async hot paths.
+
+Tier-1 CPU tests cannot see the failure modes that actually hurt this
+codebase at scale: silent recompiles, hidden host syncs inside the decode
+loop, swallowed ``CancelledError``s, impure Pallas index maps, and ad-hoc
+mesh axis names that fight the canonical sharding layout.  dynalint makes
+those invariants machine-checked:
+
+- ``DT1xx`` host-sync in hot paths (``.item()``, ``jax.device_get``,
+  ``block_until_ready`` inside ``@hot_path`` functions / hot modules)
+- ``DT2xx`` recompile hazards (mutable closures under ``jit``, Python
+  branches on traced parameters, ``jit`` built inside loops)
+- ``DT3xx`` async discipline (blocking calls in coroutines, dropped task
+  handles, ``CancelledError``-swallowing handlers)
+- ``DT4xx`` Pallas kernel contracts (index-map purity, BlockSpec/grid arity)
+- ``DT5xx`` sharding consistency (axis names / meshes outside the canonical
+  layout module ``dynamo_tpu/parallel/layout.py``)
+
+Run ``python -m dynamo_tpu.analysis --check`` (what ``scripts/verify.sh
+lint`` and CI gate on).  Suppress a finding inline with
+``# dynalint: disable=DT102`` (same line, or ``disable-next-line=`` on the
+line above); grandfathered findings live in ``dynalint-baseline.json`` at
+the repo root, regenerated with ``--update-baseline``.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisConfig,
+    Finding,
+    ModuleContext,
+    Rule,
+    analyze_source,
+    iter_python_files,
+    run_paths,
+)
+from .baseline import Baseline, fingerprint  # noqa: F401
+from .rules import ALL_RULES, rules_for  # noqa: F401

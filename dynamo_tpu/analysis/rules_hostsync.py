@@ -1,0 +1,91 @@
+"""DT1xx — host-sync in hot paths.
+
+The decode loop's throughput is set by how rarely the host touches device
+values: every ``.item()`` / ``device_get`` / ``block_until_ready`` inside a
+hot function is a full pipeline flush (PR 4 measured 3.7x tokens-per-host-
+sync from removing exactly these).  Scope: functions marked ``@hot_path``
+anywhere, or any function body in the hot-module allowlist
+(``AnalysisConfig.hot_modules`` — ops/, the JAX engine, the scheduler,
+spec decoding).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import Finding, ModuleContext, Rule
+
+_JAX_ROOTS = ("jax", "jax.numpy", "jaxlib")
+
+
+def _mentions_jax(ctx: ModuleContext, node: ast.AST) -> bool:
+    """Does any name in this subtree resolve under the jax package?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            dotted = ctx.dotted(sub)
+            if dotted and (dotted == "jax"
+                           or dotted.startswith("jax.")
+                           or dotted.split(".")[0] in _JAX_ROOTS):
+                return True
+    return False
+
+
+class HostScalarSync(Rule):
+    code = "DT101"
+    name = "host-scalar-sync"
+    rationale = ("`.item()`/`.tolist()`/`int(traced)` in a hot path blocks "
+                 "the host on the device stream once per call")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.hot_scope(node):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("item", "tolist"):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`.{node.func.attr}()` forces a device→host sync in a "
+                    "hot path; keep the value on device or batch the fetch")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("int", "float", "bool")
+                  and len(node.args) == 1
+                  and _mentions_jax(ctx, node.args[0])):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{node.func.id}()` on a jax value materialises it on "
+                    "host; hot paths must not pull scalars per step")
+
+
+class HostTransferSync(Rule):
+    code = "DT102"
+    name = "host-transfer-sync"
+    rationale = ("`jax.device_get`/`block_until_ready`/`np.asarray(jax_val)` "
+                 "in a hot path stalls dispatch; syncs belong at designed "
+                 "window boundaries only")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.hot_scope(node):
+                continue
+            name = ctx.call_name(node) or ""
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{name.split('.')[-1]}` in a hot path; move the sync "
+                    "to the batching fetcher window or mark it intentional")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "block_until_ready"):
+                yield ctx.finding(
+                    self.code, node,
+                    "`.block_until_ready()` in a hot path stalls the "
+                    "dispatch pipeline")
+            elif (name in ("numpy.asarray", "numpy.array")
+                  and node.args and _mentions_jax(ctx, node.args[0])):
+                yield ctx.finding(
+                    self.code, node,
+                    "`np.asarray` on a jax value is an implicit D2H copy "
+                    "in a hot path")
+
+
+RULES = [HostScalarSync(), HostTransferSync()]

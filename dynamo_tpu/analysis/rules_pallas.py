@@ -1,0 +1,173 @@
+"""DT4xx — Pallas kernel contracts.
+
+BlockSpec index maps run at *pipeline-schedule* time: they must be pure
+functions of the grid indices and scalar-prefetch refs, and their arity
+must match ``len(grid) + num_scalar_prefetch`` exactly — Mosaic's error
+for a mismatch is an opaque lowering failure miles from the typo.  These
+rules keep ``ops/paged_attention.py`` (and future kernels) honest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .core import Finding, ModuleContext, Rule
+
+# call roots an index map may legitimately use (pure tracing arithmetic)
+_PURE_ROOTS = ("jax", "jnp", "jax.numpy", "jax.lax",
+               "jax.experimental.pallas", "pl", "math")
+_IMPURE_ROOTS = ("print", "input", "open", "numpy.random", "random",
+                 "time", "os", "io", "logging")
+
+
+def _collect_defs(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _index_map_node(ctx: ModuleContext, blockspec: ast.Call,
+                    defs: Dict[str, ast.AST]) -> Optional[ast.AST]:
+    """The lambda / def node behind a BlockSpec's index_map, if resolvable."""
+    im: Optional[ast.AST] = None
+    if len(blockspec.args) >= 2:
+        im = blockspec.args[1]
+    for kw in blockspec.keywords:
+        if kw.arg == "index_map":
+            im = kw.value
+    if im is None:
+        return None
+    if isinstance(im, ast.Lambda):
+        return im
+    if isinstance(im, ast.Name):
+        return defs.get(im.id)
+    return None
+
+
+def _arity(fn: ast.AST) -> Optional[int]:
+    args = fn.args
+    if args.vararg is not None:
+        return None  # *args absorbs anything — can't check statically
+    return len(args.posonlyargs) + len(args.args)
+
+
+def _iter_blockspecs(ctx: ModuleContext,
+                     container: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(container):
+        if isinstance(node, ast.Call) and \
+                (ctx.call_name(node) or "").endswith("BlockSpec"):
+            yield node
+
+
+class IndexMapPurity(Rule):
+    code = "DT401"
+    name = "index-map-purity"
+    rationale = ("BlockSpec index maps run at pipeline-schedule time; any "
+                 "side effect or host call there is undefined behaviour "
+                 "under Mosaic's double-buffered prefetch")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        defs = _collect_defs(ctx)
+        seen = set()
+        for spec in _iter_blockspecs(ctx, ctx.tree):
+            fn = _index_map_node(ctx, spec, defs)
+            if fn is None or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.Global, ast.Nonlocal)):
+                        yield ctx.finding(
+                            self.code, node,
+                            "index map declares global/nonlocal state — "
+                            "index maps must be pure")
+                    elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                        targets = (node.targets
+                                   if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                               for t in targets):
+                            yield ctx.finding(
+                                self.code, node,
+                                "index map writes through an attribute/"
+                                "subscript — index maps must be pure")
+                    elif isinstance(node, ast.Call):
+                        name = ctx.call_name(node) or ""
+                        root = name.split(".")[0]
+                        if name in _IMPURE_ROOTS or root in _IMPURE_ROOTS \
+                                or name.startswith("numpy.random."):
+                            yield ctx.finding(
+                                self.code, node,
+                                f"index map calls impure/host `{name}`; "
+                                "only grid arithmetic is allowed")
+
+
+class BlockSpecArity(Rule):
+    code = "DT402"
+    name = "blockspec-grid-arity"
+    rationale = ("index-map arity must equal len(grid) + num_scalar_prefetch;"
+                 " a mismatch surfaces as an opaque Mosaic lowering error")
+
+    def _expected(self, ctx: ModuleContext,
+                  call: ast.Call) -> Tuple[Optional[int], Optional[int]]:
+        """(len(grid), num_scalar_prefetch) when statically known."""
+        grid_len = prefetch = None
+        for kw in call.keywords:
+            if kw.arg == "grid" and isinstance(kw.value, ast.Tuple):
+                grid_len = len(kw.value.elts)
+            elif kw.arg == "num_scalar_prefetch" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                prefetch = kw.value.value
+        return grid_len, prefetch
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        defs = _collect_defs(ctx)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = ctx.call_name(call) or ""
+            is_gridspec = name.endswith("PrefetchScalarGridSpec") or \
+                name.endswith("GridSpec")
+            is_pallas_call = name.endswith("pallas_call")
+            if not (is_gridspec or is_pallas_call):
+                continue
+            grid_len, prefetch = self._expected(ctx, call)
+            if is_pallas_call and prefetch is None:
+                prefetch = 0  # plain pallas_call: maps take grid indices only
+            arities: List[Tuple[ast.Call, int]] = []
+            for spec in _iter_blockspecs(ctx, call):
+                fn = _index_map_node(ctx, spec, defs)
+                if fn is None:
+                    continue
+                n = _arity(fn)
+                if n is not None:
+                    arities.append((spec, n))
+            if not arities:
+                continue
+            if grid_len is not None and prefetch is not None:
+                want = grid_len + prefetch
+                for spec, n in arities:
+                    if n != want:
+                        yield ctx.finding(
+                            self.code, spec,
+                            f"index map takes {n} args but grid has "
+                            f"{grid_len} dims + {prefetch} scalar-prefetch "
+                            f"refs (= {want})")
+            else:
+                # grid unknown statically: at least demand consistency
+                counts = {n for _, n in arities}
+                if len(counts) > 1:
+                    for spec, n in arities:
+                        yield ctx.finding(
+                            self.code, spec,
+                            f"index maps of one launch disagree on arity "
+                            f"({sorted(counts)}); all BlockSpecs must see "
+                            "the same grid")
+
+
+RULES = [IndexMapPurity(), BlockSpecArity()]

@@ -1,0 +1,85 @@
+"""DT5xx — sharding consistency.
+
+MULTICHIP_r05 is full of `[SPMD] Involuntary full rematerialization`
+warnings because weights and activations disagree about the mesh layout.
+The fix (ROADMAP item 2) is a single canonical layout module; these rules
+stop new ad-hoc axis names and meshes from growing back while that
+refactor lands.  Axis-name constants live in
+``dynamo_tpu/parallel/layout.py`` — everything else must import them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from .core import Finding, ModuleContext, Rule
+
+_SPEC_CALLS = ("PartitionSpec", "NamedSharding")
+_AXIS_KWARGS = ("axis_name", "axis_names")
+
+
+def _axis_literals(ctx: ModuleContext, node: ast.AST) -> Set[str]:
+    found: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and \
+                isinstance(sub.value, str) and \
+                sub.value in ctx.config.axis_names:
+            found.add(sub.value)
+    return found
+
+
+class HardcodedAxisName(Rule):
+    code = "DT501"
+    name = "hardcoded-mesh-axis"
+    rationale = ("mesh axis names spelled as string literals drift between "
+                 "modules and produce sharding mismatches the compiler "
+                 "papers over with full rematerialization; import the "
+                 "constants from parallel/layout.py")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_layout_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.call_name(node) or ""
+            in_spec_call = any(name == c or name.endswith("." + c)
+                               for c in _SPEC_CALLS)
+            axes: Set[str] = set()
+            if in_spec_call:
+                axes |= _axis_literals(ctx, node)
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    axes |= _axis_literals(ctx, kw.value)
+            if axes:
+                names = ", ".join(f'"{a}"' for a in sorted(axes))
+                yield ctx.finding(
+                    self.code, node,
+                    f"hard-coded mesh axis name(s) {names}; use the "
+                    "canonical constants from dynamo_tpu.parallel.layout")
+
+
+class AdHocMesh(Rule):
+    code = "DT502"
+    name = "ad-hoc-mesh"
+    rationale = ("every Mesh built outside the canonical layout module is "
+                 "one more place device order and axis naming can disagree "
+                 "with the engine's expectations")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_layout_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.call_name(node) or ""
+            if name == "Mesh" or name.endswith(".Mesh") or \
+                    name.endswith(".create_device_mesh"):
+                yield ctx.finding(
+                    self.code, node,
+                    "Mesh constructed outside dynamo_tpu/parallel/layout.py;"
+                    " build it through the canonical layout module")
+
+
+RULES = [HardcodedAxisName(), AdHocMesh()]

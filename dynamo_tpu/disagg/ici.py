@@ -43,13 +43,14 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..engine.engine import _pow2_bucket
+from ..parallel.layout import AXIS_TP
 from ..utils.logging import get_logger
 
 log = get_logger("disagg.ici")
 
 # data layout produced by the jitted extract: [L, N, KV, bs, hd];
 # KV heads (axis 2) carry the tensor-parallel sharding.
-_DATA_SPEC = P(None, None, "tp", None, None)
+_DATA_SPEC = P(None, None, AXIS_TP, None, None)
 
 
 class DevicePlane:

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel.layout import AXIS_SP, AXIS_TP, make_flat_mesh, make_mesh
 from .config import EngineConfig, ModelConfig
 
 Params = Dict[str, Any]
@@ -115,12 +116,6 @@ def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
 # ---------------------------- shardings ----------------------------------
 
 
-def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    dp, tp = shape
-    return Mesh(devices[: dp * tp].reshape(dp, tp), ("dp", "tp"))
-
-
 def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
     """Megatron-style column/row TP over the ``tp`` mesh axis."""
     def s(*spec):
@@ -128,36 +123,36 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
 
     layers: Params = {
         "attn_norm": s(None, None),
-        "wq": s(None, None, "tp"),
-        "wk": s(None, None, "tp"),
-        "wv": s(None, None, "tp"),
-        "wo": s(None, "tp", None),
+        "wq": s(None, None, AXIS_TP),
+        "wk": s(None, None, AXIS_TP),
+        "wv": s(None, None, AXIS_TP),
+        "wo": s(None, AXIS_TP, None),
         "mlp_norm": s(None, None),
     }
     if cfg.is_moe:
         # expert parallelism: experts sharded over the model axis; the
         # dispatch/combine einsums become all-to-alls under GSPMD
         layers["w_router"] = s(None, None, None)
-        layers["w_gate"] = s(None, "tp", None, None)
-        layers["w_up"] = s(None, "tp", None, None)
-        layers["w_down"] = s(None, "tp", None, None)
+        layers["w_gate"] = s(None, AXIS_TP, None, None)
+        layers["w_up"] = s(None, AXIS_TP, None, None)
+        layers["w_down"] = s(None, AXIS_TP, None, None)
     else:
-        layers["w_gate"] = s(None, None, "tp")
-        layers["w_up"] = s(None, None, "tp")
-        layers["w_down"] = s(None, "tp", None)
+        layers["w_gate"] = s(None, None, AXIS_TP)
+        layers["w_up"] = s(None, None, AXIS_TP)
+        layers["w_down"] = s(None, AXIS_TP, None)
     shardings: Params = {
         "embed": s(None, None),
         "layers": layers,
         "final_norm": s(None),
     }
     if not cfg.tie_word_embeddings:
-        shardings["lm_head"] = s(None, "tp")
+        shardings["lm_head"] = s(None, AXIS_TP)
     return shardings
 
 
 def cache_shardings(mesh: Mesh, cfg: ModelConfig) -> Cache:
     # KV heads sharded over tp so each shard holds the heads it computes
-    spec = NamedSharding(mesh, P(None, "tp", None, None))
+    spec = NamedSharding(mesh, P(None, AXIS_TP, None, None))
     return {
         "k": [spec] * cfg.num_layers,
         "v": [spec] * cfg.num_layers,
@@ -295,15 +290,15 @@ def _paged_decode_attention(
         interpret=interpret,
     )
     q3 = q[:, 0]  # [B, H, hd]
-    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+    if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
         out = jax.shard_map(
             lambda q_, k_, v_, t_, s_: kernel(q_, k_, v_, t_, s_),
             mesh=mesh,
             in_specs=(
-                P(None, "tp", None), P(None, "tp", None, None),
-                P(None, "tp", None, None), P(None, None), P(None),
+                P(None, AXIS_TP, None), P(None, AXIS_TP, None, None),
+                P(None, AXIS_TP, None, None), P(None, None), P(None),
             ),
-            out_specs=P(None, "tp", None),
+            out_specs=P(None, AXIS_TP, None),
             check_vma=False,  # pallas_call outputs carry no vma info
         )(q3, lk, lv, block_tables, seq_lens)
     else:
@@ -340,18 +335,18 @@ def _paged_ragged_attention(
     )
     q_flat = q.reshape(B * T, H, hd)
     q_start = jnp.arange(B + 1, dtype=jnp.int32) * T
-    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+    if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
         out = jax.shard_map(
             lambda q_, k_, v_, t_, s_, ql_, cl_: kernel(
                 q_, k_, v_, t_, s_, ql_, cl_
             ),
             mesh=mesh,
             in_specs=(
-                P(None, "tp", None), P(None, "tp", None, None),
-                P(None, "tp", None, None), P(None, None), P(None),
+                P(None, AXIS_TP, None), P(None, AXIS_TP, None, None),
+                P(None, AXIS_TP, None, None), P(None, None), P(None),
                 P(None), P(None),
             ),
-            out_specs=P(None, "tp", None),
+            out_specs=P(None, AXIS_TP, None),
             check_vma=False,  # pallas_call outputs carry no vma info
         )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
     else:
@@ -402,7 +397,7 @@ def forward(
     if use_ring:
         # pin activations T-sharded so the whole layer stack stays O(T/sp)
         h = jax.lax.with_sharding_constraint(
-            h, NamedSharding(ring_mesh, P(None, "sp", None))
+            h, NamedSharding(ring_mesh, P(None, AXIS_SP, None))
         )
 
     # physical (block, offset) per (b, t); pads go to the trash block 0
@@ -456,9 +451,9 @@ def forward(
         if use_ring:
             from ..parallel.ring_attention import ring_attention
 
-            spec = P(None, "sp", None, None)
+            spec = P(None, AXIS_SP, None, None)
             attn = jax.shard_map(
-                functools.partial(ring_attention, axis_name="sp"),
+                functools.partial(ring_attention, axis_name=AXIS_SP),
                 mesh=ring_mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
@@ -1303,8 +1298,7 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
     steps see an unchanged (donated) cache. SURVEY §5 long-context; exact —
     ring attention accumulates online softmax in f32.
     """
-    devices = mesh.devices.flatten()
-    sp_mesh = Mesh(devices, ("sp",))
+    sp_mesh = make_flat_mesh(mesh.devices, AXIS_SP)
     out_shardings = (
         cache_shardings(mesh, cfg),
         NamedSharding(mesh, P()),
@@ -1318,8 +1312,7 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
 
 def make_sp_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
     """Ring-posting variant of the sp prefill (pipelined serving path)."""
-    devices = mesh.devices.flatten()
-    sp_mesh = Mesh(devices, ("sp",))
+    sp_mesh = make_flat_mesh(mesh.devices, AXIS_SP)
     out_shardings = (
         cache_shardings(mesh, cfg),
         NamedSharding(mesh, P()),   # last_tok

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Sequence as Seq, Tuple
 
 from ..tokens import TokenBlockSequence
+from ..utils.hotpath import hot_path
 from ..utils.logging import get_logger
 from .config import EngineConfig
 
@@ -359,6 +360,7 @@ class Scheduler:
 
     # -- planning --
 
+    @hot_path
     def schedule(self) -> ScheduledBatch:
         batch = ScheduledBatch()
         budget = self.config.max_num_batched_tokens
@@ -532,6 +534,7 @@ class Scheduler:
 
     # -- post-step bookkeeping (called by the engine executor) --
 
+    @hot_path
     def on_prefill_executed(self, chunk: PrefillChunk,
                             sampled: Optional[int]) -> None:
         seq = chunk.seq
@@ -542,6 +545,7 @@ class Scheduler:
             seq.pending_first = 0
             self._append_token(seq, sampled)
 
+    @hot_path
     def on_decode_executed(self, seq: SchedSeq, sampled: int) -> None:
         seq.num_computed += 1
         seq.pending_decode = max(0, seq.pending_decode - 1)
@@ -592,6 +596,7 @@ class Scheduler:
 
     # -- internals --
 
+    @hot_path
     def _append_token(self, seq: SchedSeq, token: int) -> None:
         seq.output_ids.append(token)
         assert seq.token_seq is not None

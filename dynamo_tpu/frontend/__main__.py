@@ -19,6 +19,7 @@ from ..llm.entrypoint import (
     EmbeddingsPipeline, build_routed_pipeline, make_kv_sink,
 )
 from ..runtime.component import DistributedRuntime
+from ..runtime.tasks import spawn_logged
 from ..utils.config import RuntimeConfig
 from ..utils.logging import get_logger
 from .service import HttpService, ModelEntry, ModelManager
@@ -233,7 +234,7 @@ async def run_frontend(args: argparse.Namespace) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(
-            sig, lambda: asyncio.ensure_future(_shutdown())
+            sig, lambda: spawn_logged(_shutdown(), name="frontend-shutdown")
         )
 
     async def _shutdown():

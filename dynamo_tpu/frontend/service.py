@@ -651,7 +651,17 @@ class HttpService:
             self._m_requests.labels(
                 model=model, endpoint=endpoint, status="200"
             ).inc()
-        except (ConnectionResetError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            # client disconnect surfaces as handler cancellation: kill the
+            # request so the worker frees the seat, then re-raise — eating
+            # the CancelledError would also absorb drain/shutdown (DT303)
+            log.info("client disconnected — killing request")
+            ctx.kill()
+            self._m_requests.labels(
+                model=model, endpoint=endpoint, status="499"
+            ).inc()
+            raise
+        except ConnectionResetError:
             log.info("client disconnected — killing request")
             ctx.kill()
             self._m_requests.labels(
@@ -762,9 +772,15 @@ class HttpService:
                 await resp.write(oai.sse_frame(chunk).encode())
             await resp.write(oai.SSE_DONE.encode())
             self._m_requests.labels(model=model, endpoint=endpoint, status="200").inc()
-        except (ConnectionResetError, asyncio.CancelledError):
+        except asyncio.CancelledError:
             # client went away: kill the request so the worker frees the slot
-            # (ref: http/service/disconnect.rs)
+            # (ref: http/service/disconnect.rs), then re-raise — swallowing
+            # the CancelledError would also absorb drain/shutdown (DT303)
+            log.info("client disconnected — killing request")
+            ctx.kill()
+            self._m_requests.labels(model=model, endpoint=endpoint, status="499").inc()
+            raise
+        except ConnectionResetError:
             log.info("client disconnected — killing request")
             ctx.kill()
             self._m_requests.labels(model=model, endpoint=endpoint, status="499").inc()

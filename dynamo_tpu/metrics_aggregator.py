@@ -23,6 +23,7 @@ from .planner.connector import planner_events_subject
 from .router.kv_router import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
 from .runtime.component import DistributedRuntime
 from .runtime.system_server import SystemServer
+from .runtime.tasks import spawn_logged
 from .utils.config import RuntimeConfig
 from .utils.logging import get_logger
 
@@ -274,7 +275,7 @@ async def run(args: argparse.Namespace) -> None:
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(
-            sig, lambda: asyncio.ensure_future(_shutdown())
+            sig, lambda: spawn_logged(_shutdown(), name="aggregator-shutdown")
         )
     log.info("metrics aggregator on %s:%d (component=%s)",
              args.host, server.port, args.component)

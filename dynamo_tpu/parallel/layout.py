@@ -1,0 +1,71 @@
+"""Canonical mesh layout — the ONE place axis names and meshes come from.
+
+Every sharded tensor in the system agrees on this vocabulary (SNIPPETS.md
+[3]: a ``SpecLayout``-style single source of truth); MULTICHIP_r05's
+involuntary-rematerialization storm came from modules free-handing their
+own axis strings and mesh shapes.  dynalint rule DT501/DT502 enforces that
+axis-name literals and ``Mesh`` construction live here and nowhere else —
+new layouts are added by extending this module, not by spelling ``"tp"``
+at a call site.
+
+Axes:
+
+- ``dp``   data parallel — independent batch shards
+- ``tp``   tensor parallel — attention/MLP heads split per chip
+- ``sp``   sequence parallel — ring/Ulysses attention over long prompts
+- ``ep``   expert parallel — MoE experts spread over chips
+- ``pp``   pipeline parallel — layer stages
+- ``fsdp`` fully-sharded data parallel (ROADMAP item 2's 2D/3D target)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+AXIS_PP = "pp"
+AXIS_FSDP = "fsdp"
+
+#: every axis name the serving system may use; dynalint's DT501 vocabulary
+#: mirrors this tuple (plus the legacy "data" alias it also polices).
+ALL_AXES: Tuple[str, ...] = (
+    AXIS_DP, AXIS_TP, AXIS_SP, AXIS_EP, AXIS_PP, AXIS_FSDP,
+)
+
+
+def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
+    """The serving engine's canonical ``(dp, tp)`` mesh.
+
+    Takes the first ``dp*tp`` devices in enumeration order so every host
+    in a multihost slice derives the identical mesh.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    dp, tp = shape
+    return Mesh(devices[: dp * tp].reshape(dp, tp), (AXIS_DP, AXIS_TP))
+
+
+def make_flat_mesh(devices, axis_name: str = AXIS_SP) -> Mesh:
+    """View a device set as one flat ring (sequence-parallel prefill)."""
+    return Mesh(np.asarray(devices).flatten(), (axis_name,))
+
+
+def make_axes_mesh(shape: Sequence[int], axis_names: Sequence[str],
+                   devices=None) -> Mesh:
+    """General N-D mesh over the leading ``prod(shape)`` devices; axis
+    names must come from :data:`ALL_AXES`."""
+    unknown = [a for a in axis_names if a not in ALL_AXES]
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axis names {unknown}; canonical axes: {ALL_AXES}")
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    return Mesh(devices.flatten()[:n].reshape(tuple(shape)),
+                tuple(axis_names))

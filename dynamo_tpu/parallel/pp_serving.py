@@ -42,13 +42,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.config import EngineConfig, ModelConfig
 from ..engine import model as model_lib
+from .layout import AXIS_PP, make_axes_mesh
 
 Cache = dict
 
 
 def make_pp_mesh(num_stages: int, devices=None) -> Mesh:
     devices = np.asarray(devices if devices is not None else jax.devices())
-    return Mesh(devices[:num_stages], ("pp",))
+    return make_axes_mesh((num_stages,), (AXIS_PP,),
+                          devices=devices[:num_stages])
 
 
 def init_pp_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:

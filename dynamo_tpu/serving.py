@@ -15,6 +15,7 @@ from .engine.engine import EngineCore
 from .llm.discovery import ModelDeploymentCard, register_llm
 from .llm.tokenizer import Tokenizer
 from .runtime.component import DistributedRuntime
+from .runtime.tasks import spawn_logged
 from .utils.config import RuntimeConfig
 from .utils.logging import get_logger
 
@@ -99,11 +100,11 @@ async def serve_engine(
 
         def _withdraw(name: str) -> None:
             log.warning("health probe %s unhealthy — withdrawing instance", name)
-            asyncio.ensure_future(served.withdraw())
+            spawn_logged(served.withdraw(), name="health-withdraw")
 
         def _readvertise(name: str) -> None:
             log.info("health probe %s recovered — re-advertising instance", name)
-            asyncio.ensure_future(served.readvertise())
+            spawn_logged(served.readvertise(), name="health-readvertise")
 
         health = HealthCheckManager(
             HealthCheckConfig(period_s=runtime.config.health_check_period_s),
@@ -194,7 +195,7 @@ async def run_until_shutdown(
         drained["fired"] = True
         log.info("drain requested — deregistering and finishing in-flight "
                  "work (deadline %.1fs)", runtime.config.drain_timeout_s)
-        asyncio.ensure_future(_shutdown())
+        spawn_logged(_shutdown(), name="drain-shutdown")
 
     async def _shutdown():
         health = getattr(served, "health_manager", None)

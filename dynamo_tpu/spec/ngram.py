@@ -18,7 +18,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils.hotpath import hot_path
 
+
+@hot_path
 def _propose_row(hist: jax.Array, pos0: jax.Array, k: int,
                  ngram_min: int, ngram_max: int) -> jax.Array:
     """Drafts for one history row.
@@ -64,6 +67,7 @@ def _propose_row(hist: jax.Array, pos0: jax.Array, k: int,
     return jnp.where(ok, d, -1).astype(jnp.int32)
 
 
+@hot_path
 def propose_drafts(hist: jax.Array, pos0: jax.Array, k: int,
                    ngram_min: int, ngram_max: int) -> jax.Array:
     """Batched drafter: hist [B, H], pos0 [B] -> drafts [B, k] (-1-padded)."""

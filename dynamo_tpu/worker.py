@@ -214,7 +214,7 @@ async def run_worker(args: argparse.Namespace) -> None:
             await follower_loop(runtime, engine, mh, name,
                                 component=args.component)
             log.warning("follower exiting (leader lost)")
-        except BaseException:
+        except BaseException:  # dynalint: disable=DT303 — os._exit below
             # the traceback must hit the log BEFORE the hard exit below
             # discards it — a replay bug would otherwise masquerade as
             # endless "leader lost" restarts
@@ -223,7 +223,9 @@ async def run_worker(args: argparse.Namespace) -> None:
             try:
                 await asyncio.wait_for(engine.stop(), timeout=10)
                 await asyncio.wait_for(runtime.shutdown(), timeout=10)
-            except BaseException:  # incl. CancelledError — must not skip
+            except BaseException:  # dynalint: disable=DT303
+                # incl. CancelledError — the hard os._exit(1) below is the
+                # contract; nothing may skip it
                 log.exception("follower cleanup failed")
             # hard exit: jax.distributed's atexit barrier blocks forever
             # when the coordinator host is gone, and the supervisor's

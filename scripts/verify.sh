@@ -13,6 +13,9 @@
 #   scripts/verify.sh planner     # closed-loop planner suite incl. the
 #                                 # 100+-worker sim sweep; echoes the repro
 #                                 # seed (DYNTPU_PLANNER_SEED=<n>) on failure
+#   scripts/verify.sh lint        # dynalint static analysis (--check) +
+#                                 # analyzer unit tests; echoes the repro
+#                                 # line on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -30,6 +33,20 @@ fi
 if [ "${1:-}" = "kernel" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernel \
         -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "lint" ]; then
+    rc=0
+    env JAX_PLATFORMS=cpu python -m dynamo_tpu.analysis --check || rc=$?
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+        -p no:cacheprovider || rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "dynalint FAILED; reproduce with:"
+        echo "  python -m dynamo_tpu.analysis --check"
+        echo "fix the finding, add '# dynalint: disable=DTxxx' with a reason,"
+        echo "or (grandfathering only) python -m dynamo_tpu.analysis --update-baseline"
+    fi
+    exit $rc
 fi
 
 if [ "${1:-}" = "resilience" ]; then

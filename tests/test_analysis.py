@@ -1,0 +1,563 @@
+"""dynalint (dynamo_tpu.analysis) — rule, suppression, baseline and CLI
+tests.
+
+Each rule family gets a positive fixture (the hazard fires), a negative
+fixture (the idiomatic alternative stays quiet), and a suppressed fixture
+(`# dynalint: disable=DTxxx` silences it).  The e2e tests then assert the
+real repo is clean modulo the committed baseline and that an injected
+violation fails the CLI — the exact contract ``scripts/verify.sh lint``
+gates on.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from dynamo_tpu.analysis import (
+    ALL_RULES,
+    AnalysisConfig,
+    Baseline,
+    analyze_source,
+    fingerprint,
+    rules_for,
+)
+from dynamo_tpu.analysis.__main__ import main as dynalint_main
+from dynamo_tpu.utils.hotpath import hot_path
+
+pytestmark = pytest.mark.analysis
+
+HOT = "dynamo_tpu/ops/fixture.py"        # in the hot-module allowlist
+COLD = "dynamo_tpu/llm/fixture.py"       # not in it
+LAYOUT = "dynamo_tpu/parallel/layout.py"
+
+
+def lint(src, path=COLD, select=None, **kw):
+    rules = rules_for(select) if select else ALL_RULES
+    return analyze_source(textwrap.dedent(src), path, rules, **kw)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions, syntax errors, registry
+
+
+def test_rule_registry_covers_all_families():
+    by_family = {r.code[:3] for r in ALL_RULES}
+    assert {"DT1", "DT2", "DT3", "DT4", "DT5"} <= by_family
+    assert len(ALL_RULES) >= 6
+    assert len({r.code for r in ALL_RULES}) == len(ALL_RULES)
+
+
+def test_rules_for_selects_by_code_and_prefix():
+    assert codes([f for r in rules_for(["DT3"]) for f in []]) == []
+    assert {r.code for r in rules_for(["DT302"])} == {"DT302"}
+    assert {r.code[:3] for r in rules_for(["DT1"])} == {"DT1"}
+    with pytest.raises(ValueError):
+        rules_for(["DT999"])
+
+
+def test_syntax_error_is_dt001():
+    assert codes(lint("def f(:\n    pass\n")) == ["DT001"]
+
+
+def test_suppress_same_line_and_next_line():
+    src = """
+    import jax
+    def step(tok):
+        a = jax.device_get(tok)  # dynalint: disable=DT102
+        # dynalint: disable-next-line=DT102
+        b = jax.device_get(tok)
+        return a, b
+    """
+    assert lint(src, path=HOT) == []
+
+
+def test_suppress_all_wildcard():
+    src = """
+    import jax
+    def step(tok):
+        return jax.device_get(tok)  # dynalint: disable=all
+    """
+    assert lint(src, path=HOT) == []
+
+
+def test_suppression_is_code_specific():
+    src = """
+    import jax
+    def step(tok):
+        return jax.device_get(tok)  # dynalint: disable=DT101
+    """
+    assert codes(lint(src, path=HOT)) == ["DT102"]
+
+
+# ---------------------------------------------------------------------------
+# DT1xx — host sync in hot paths
+
+
+def test_dt101_item_and_int_on_jax_value_in_hot_module():
+    src = """
+    import jax
+    def step(tok):
+        return tok.item(), int(jax.device_put(tok))
+    """
+    found = lint(src, path=HOT, select=["DT101"])
+    assert codes(found) == ["DT101", "DT101"]
+
+
+def test_dt101_hot_path_decorator_extends_scope_to_cold_modules():
+    src = """
+    import jax
+    from dynamo_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def step(tok):
+        return tok.item()
+    """
+    assert codes(lint(src, path=COLD, select=["DT101"])) == ["DT101"]
+
+
+def test_dt101_quiet_in_cold_module_and_at_module_level():
+    src = """
+    import jax
+    def load_checkpoint(x):
+        return x.item()
+    """
+    assert lint(src, path=COLD, select=["DT101"]) == []
+    # module level of a hot module runs at import time — cold by definition
+    assert lint("import jax\nx = 1\ny = int(x)\n", path=HOT,
+                select=["DT101"]) == []
+
+
+def test_dt102_device_get_and_asarray_on_jax_value():
+    src = """
+    import jax
+    import numpy as np
+    def step(tok):
+        a = jax.device_get(tok)
+        b = np.asarray(jax.device_put(tok))
+        tok.block_until_ready()
+        return a, b
+    """
+    assert codes(lint(src, path=HOT, select=["DT102"])) == ["DT102"] * 3
+
+
+def test_dt102_quiet_for_host_numpy():
+    src = """
+    import numpy as np
+    def step(rows):
+        return np.asarray(rows)
+    """
+    assert lint(src, path=HOT, select=["DT102"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DT2xx — recompile hazards
+
+
+def test_dt201_jit_reading_mutable_module_global():
+    src = """
+    import jax
+    CACHE = {}
+
+    @jax.jit
+    def f(x):
+        return CACHE["scale"] * x
+    """
+    assert codes(lint(src, select=["DT201"])) == ["DT201"]
+
+
+def test_dt201_quiet_when_state_is_a_parameter():
+    src = """
+    import jax
+    CACHE = {}
+
+    @jax.jit
+    def f(x, cache):
+        return cache["scale"] * x
+
+    y = f(1.0, CACHE)
+    """
+    assert lint(src, select=["DT201"]) == []
+
+
+def test_dt202_python_branch_on_traced_param():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert codes(lint(src, select=["DT202"])) == ["DT202"]
+
+
+def test_dt202_static_shape_and_none_tests_are_fine():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x, n, mask=None):
+        if n > 4 and x.shape[0] > 8 and mask is None:
+            return x
+        return -x
+    """
+    assert lint(src, select=["DT202"]) == []
+
+
+def test_dt202_partial_bound_leading_args_are_static():
+    src = """
+    import jax
+    import functools
+
+    def kernel(cfg, x):
+        if cfg > 0:
+            return x
+        return -x
+
+    step = jax.jit(functools.partial(kernel, 4))
+    """
+    assert lint(src, select=["DT202"]) == []
+
+
+def test_dt203_jit_constructed_in_loop():
+    src = """
+    import jax
+    def run(fns, xs):
+        outs = []
+        for fn in fns:
+            outs.append(jax.jit(fn)(xs))
+        return outs
+    """
+    assert codes(lint(src, select=["DT203"])) == ["DT203"]
+    hoisted = """
+    import jax
+    def run(fns, xs):
+        jitted = [jax.jit(fn) for fn in fns]
+        return [fn(xs) for fn in jitted]
+    """
+    assert lint(hoisted, select=["DT203"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DT3xx — async discipline
+
+
+def test_dt301_blocking_call_in_coroutine():
+    src = """
+    import asyncio
+    import time
+
+    async def poll():
+        time.sleep(0.5)
+    """
+    assert codes(lint(src, select=["DT301"])) == ["DT301"]
+    sync = "import time\ndef poll():\n    time.sleep(0.5)\n"
+    assert lint(sync, select=["DT301"]) == []
+
+
+def test_dt302_statement_level_and_lambda_spawns():
+    src = """
+    import asyncio
+
+    async def serve(loop, shutdown):
+        asyncio.create_task(shutdown())
+        loop.add_signal_handler(2, lambda: asyncio.ensure_future(shutdown()))
+    """
+    assert codes(lint(src, select=["DT302"])) == ["DT302", "DT302"]
+
+
+def test_dt302_assigned_but_never_used_handle():
+    src = """
+    import asyncio
+
+    async def serve(work):
+        t = asyncio.create_task(work())
+    """
+    assert codes(lint(src, select=["DT302"])) == ["DT302"]
+
+
+def test_dt302_quiet_when_handle_is_kept_or_awaited():
+    src = """
+    import asyncio
+
+    async def serve(work, registry):
+        t = asyncio.create_task(work())
+        registry.add(t)
+        await asyncio.create_task(work())
+    """
+    assert lint(src, select=["DT302"]) == []
+
+
+def test_dt303_bare_except_in_coroutine():
+    src = """
+    async def pump(stream):
+        try:
+            await stream.next()
+        except:
+            pass
+    """
+    assert codes(lint(src, select=["DT303"])) == ["DT303"]
+
+
+def test_dt303_base_exception_without_reraise():
+    src = """
+    async def pump(stream):
+        try:
+            await stream.next()
+        except BaseException as e:
+            log(e)
+    """
+    assert codes(lint(src, select=["DT303"])) == ["DT303"]
+
+
+def test_dt303_quiet_for_exception_reraise_and_cancel_join():
+    src = """
+    import asyncio
+
+    async def pump(stream, task):
+        try:
+            await stream.next()
+        except Exception:
+            pass            # Exception doesn't catch CancelledError
+        try:
+            await stream.next()
+        except BaseException:
+            raise           # re-raised — cancellation propagates
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass            # the standard cancel-join idiom
+    """
+    assert lint(src, select=["DT303"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DT4xx — Pallas kernel contracts
+
+
+def test_dt401_impure_index_map():
+    src = """
+    from jax.experimental import pallas as pl
+
+    spec = pl.BlockSpec((8, 128), lambda i, j: print(i))
+    """
+    assert codes(lint(src, select=["DT401"])) == ["DT401"]
+    pure = """
+    from jax.experimental import pallas as pl
+
+    spec = pl.BlockSpec((8, 128), lambda i, j: (i, 0))
+    """
+    assert lint(pure, select=["DT401"]) == []
+
+
+def test_dt402_index_map_arity_must_match_grid_plus_prefetch():
+    src = """
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental import pallas as pl
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(4, 8),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+    )
+    """
+    found = lint(src, select=["DT402"])
+    assert codes(found) == ["DT402"]
+    assert "4" in found[0].message and "2" in found[0].message
+
+
+def test_dt402_clean_kernel_launch():
+    src = """
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental import pallas as pl
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(4, 8),
+        in_specs=[pl.BlockSpec((8, 128), lambda s0, s1, i, j: (i, j))],
+    )
+    """
+    assert lint(src, select=["DT402"]) == []
+
+
+def test_dt402_plain_pallas_call_defaults_to_zero_prefetch():
+    src = """
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128,), lambda i, j: (i,))],
+    )
+    """
+    assert codes(lint(src, select=["DT402"])) == ["DT402"]
+
+
+# ---------------------------------------------------------------------------
+# DT5xx — sharding consistency
+
+
+def test_dt501_hardcoded_axis_literal():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    def shardings():
+        return P(None, "tp")
+    """
+    assert codes(lint(src, select=["DT501"])) == ["DT501"]
+
+
+def test_dt501_quiet_for_imported_constants_and_layout_module():
+    src = """
+    from jax.sharding import PartitionSpec as P
+    from dynamo_tpu.parallel.layout import AXIS_TP
+
+    def shardings():
+        return P(None, AXIS_TP)
+    """
+    assert lint(src, select=["DT501"]) == []
+    literal = """
+    from jax.sharding import PartitionSpec as P
+    SPEC = P(None, "tp")
+    """
+    assert lint(literal, path=LAYOUT, select=["DT501"]) == []
+
+
+def test_dt502_mesh_outside_layout_module():
+    src = """
+    from jax.sharding import Mesh
+
+    def make(devices):
+        return Mesh(devices, ("dp",))
+    """
+    assert codes(lint(src, select=["DT502"])) == ["DT502"]
+    assert lint(src, path=LAYOUT, select=["DT502"]) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+BAD_ASYNC = """
+import asyncio
+
+async def serve(work):
+    asyncio.create_task(work())
+"""
+
+
+def test_baseline_absorbs_grandfathered_findings():
+    found = lint(BAD_ASYNC)
+    assert codes(found) == ["DT302"]
+    baseline = Baseline.from_findings(found)
+    new, old, stale = baseline.partition(found)
+    assert new == [] and len(old) == 1 and stale == 0
+
+
+def test_baseline_fingerprint_survives_line_shifts():
+    shifted = "# a new comment line\n" + BAD_ASYNC
+    a, b = lint(BAD_ASYNC)[0], lint(shifted)[0]
+    assert a.line != b.line
+    assert fingerprint(a) == fingerprint(b)
+
+
+def test_baseline_counts_are_consumed_not_wildcarded():
+    found = lint(BAD_ASYNC)
+    baseline = Baseline.from_findings(found)
+    doubled = BAD_ASYNC + "\n\nasync def serve2(work):\n" \
+        "    asyncio.create_task(work())\n"
+    new, old, stale = baseline.partition(lint(doubled))
+    # the second copy lives in a different function — a fresh finding
+    assert len(old) == 1 and len(new) == 1
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    found = lint(BAD_ASYNC)
+    path = tmp_path / "baseline.json"
+    Baseline.from_findings(found).save(path)
+    loaded = Baseline.load(path)
+    new, old, stale = loaded.partition([])
+    assert new == [] and old == [] and stale == 1
+    data = json.loads(path.read_text())
+    assert data["findings"][0]["code"] == "DT302"
+
+
+# ---------------------------------------------------------------------------
+# hot_path marker
+
+
+def test_hot_path_is_a_runtime_noop():
+    @hot_path
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert f.__dynalint_hot_path__ is True
+
+
+# ---------------------------------------------------------------------------
+# CLI / e2e — the contract scripts/verify.sh lint gates on
+
+
+def test_repo_is_clean_modulo_committed_baseline(capsys):
+    assert dynalint_main(["--check"]) == 0
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+
+
+def test_committed_baseline_never_grows(capsys):
+    from dynamo_tpu.analysis.__main__ import find_repo_root
+    from dynamo_tpu.analysis.baseline import DEFAULT_BASELINE_NAME
+    from pathlib import Path
+
+    root = find_repo_root(Path(__file__).resolve().parent)
+    baseline = Baseline.load(root / DEFAULT_BASELINE_NAME)
+    # 7 findings grandfathered at introduction (engine KV-extract / embed
+    # slow paths); shrink it when you fix one, never regrow it
+    assert 0 < baseline.total <= 7
+
+
+def test_cli_fails_on_injected_violation(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_ASYNC, encoding="utf-8")
+    assert dynalint_main([str(bad), "--check"]) == 1
+    assert "DT302" in capsys.readouterr().out
+
+
+def test_cli_passes_on_clean_file(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n", encoding="utf-8")
+    assert dynalint_main([str(good), "--check"]) == 0
+
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_ASYNC, encoding="utf-8")
+    assert dynalint_main([str(bad), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"][0]["code"] == "DT302"
+
+
+def test_cli_select_and_list_rules(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_ASYNC, encoding="utf-8")
+    # selecting an unrelated family ignores the DT302 violation
+    assert dynalint_main([str(bad), "--select", "DT4"]) == 0
+    assert dynalint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule.code in listing
+
+
+def test_cli_rejects_unknown_selector():
+    assert dynalint_main(["--select", "DT999"]) == 2
