@@ -72,28 +72,16 @@ GPU_PARITY_TOKS = {
     "70b": 450.0,
 }
 
-# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-DEFAULT_PEAK = 197e12  # v5e — the BASELINE.md target platform
-CPU_PEAK = 1e12        # nominal, so the CPU-fallback MFU field is defined
+# Peak FLOP/s per chip and the analytic FLOPs model live in
+# dynamo_tpu.observability.flops — ONE model shared with the engine's
+# flight recorder, so bench MFU and the live engine_mfu gauge agree.
 
 
-def _peak_flops(device_kind: str, platform: str) -> float:
-    if platform != "tpu":
-        return CPU_PEAK
-    kind = device_kind.lower()
-    for key in sorted(PEAK_FLOPS, key=len, reverse=True):
-        if key in kind:
-            return PEAK_FLOPS[key]
-    return DEFAULT_PEAK
+def _peak_flops(device_kind: str, platform: str,
+                dtype: str = "bfloat16") -> float:
+    from dynamo_tpu.observability.flops import peak_flops
+
+    return peak_flops(device_kind, platform, dtype)
 
 
 def _pct(values, q):
@@ -419,6 +407,11 @@ async def run_bench() -> dict:
     itls.clear()
     done_tokens[0] = 0
     engine.num_fetch_syncs = 0  # count only measured-loop host syncs
+    # flight recorder: drop warmup windows from the live gauges and arm
+    # the steady-state recompile watchdog — any compile from here on is a
+    # shape leak the result will carry in recompiles_steady_state
+    if hasattr(engine, "mark_obs_warmup_done"):
+        engine.mark_obs_warmup_done()
 
     sem = asyncio.Semaphore(concurrency)
 
@@ -436,13 +429,26 @@ async def run_bench() -> dict:
     # the unit honest and MFU <= 1
     n_chips = eng_cfg.mesh_shape[0] * eng_cfg.mesh_shape[1]
     out_toks = done_tokens[0] / elapsed / n_chips
-    # MFU: every processed token (prefill + decode) costs ~2*n_params
-    # matmul FLOPs; attention-score FLOPs are <5% at these ISLs and are
-    # left out, making this a slight underestimate. n_params spans the
-    # whole mesh, so FLOPs/chip = 2 * n_params * processed / n_chips.
+    # MFU from the shared analytic model (dynamo_tpu.observability.flops):
+    # matmul term = 2 * active params / token, PLUS the attention-score
+    # term (4 * L * H * hd * context / token) the old 2·N·params formula
+    # dropped. Both are reported: "mfu" is the total, "mfu_model_only"
+    # the matmul-only figure comparable to older BENCH_*.json files.
+    # n_params spans the whole mesh, so FLOPs are divided by n_chips.
+    from dynamo_tpu.observability.flops import FlopsModel
+
+    fm = FlopsModel(model_cfg)
     processed = num_requests * (isl + osl) / elapsed
-    peak = _peak_flops(getattr(dev, "device_kind", ""), platform)
-    mfu = 2.0 * n_params * processed / n_chips / peak
+    peak = _peak_flops(getattr(dev, "device_kind", ""), platform,
+                       model_cfg.dtype)
+    mfu = (num_requests * fm.sequence_flops(isl, osl)
+           / elapsed / n_chips / peak)
+    mfu_model_only = fm.matmul_per_token * processed / n_chips / peak
+    # the LIVE recorder's post-warmup view (padding and spec-reject waste,
+    # per-class MFU, steady-state recompiles) — measured at dispatch/landing
+    # inside the engine, not recomputed from request counts
+    obs = (engine.obs_snapshot()
+           if hasattr(engine, "obs_snapshot") else {}) or {}
     result = {
         "metric": f"output tok/s/chip, llama-{model_name} agg greedy "
                   f"ISL={isl} OSL={osl} conc={concurrency} "
@@ -467,6 +473,14 @@ async def run_bench() -> dict:
         "n_params": n_params,
         "processed_tok_s": round(processed, 1),
         "mfu": round(mfu, 4),
+        "mfu_model_only": round(mfu_model_only, 4),
+        # live flight-recorder accounting (engine-measured, post-warmup)
+        "mfu_prefill": round(obs.get("mfu_prefill", 0.0), 6),
+        "mfu_decode": round(obs.get("mfu_decode", 0.0), 6),
+        "padding_waste_ratio": round(obs.get("padding_waste_ratio", 0.0), 4),
+        "goodput_tok_s": round(obs.get("goodput_tok_s", 0.0), 1),
+        "recompiles_steady_state": int(
+            obs.get("recompiles_steady_state", 0)),
         # channel-traffic counters: each delta is 2 uploads, each prefill
         # 2, cols 1, windows 0 — the serial-channel budget explains the
         # gap between device compute (~3 ms/window) and wall time

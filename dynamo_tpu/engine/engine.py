@@ -26,8 +26,15 @@ import jax
 import numpy as np
 
 from .. import tracing
+from ..observability import compilewatch
+from ..observability import flops as obs_flops
+from ..observability.flops import FlopsModel
+from ..observability.stepstats import (
+    DECODE, PREFILL, SPEC_VERIFY, StepRecord, StepStats,
+)
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
+from ..utils.config import env_flag, env_float, env_str
 from ..utils.hotpath import hot_path
 from ..utils.logging import get_logger
 from .config import EngineConfig, ModelConfig
@@ -221,6 +228,9 @@ class EngineCore(AsyncEngine):
         # SpecDecodeStats when spec decode is active (InferenceEngine sets
         # it); published worker → aggregator and stamped on decode spans
         self.spec_stats = None
+        # flight recorder (observability.StepStats) when enabled;
+        # InferenceEngine builds it, the mocker leaves it None
+        self.obs = None
 
     # ------------------------- lifecycle -------------------------------
 
@@ -530,8 +540,36 @@ class EngineCore(AsyncEngine):
             if getattr(self, "spec_stats", None) is not None:
                 attrs["spec_drafted"] = seq.spec_drafted
                 attrs["spec_accepted"] = seq.spec_accepted
+            if self.obs is not None:
+                osnap = self.obs.snapshot()
+                attrs["mfu"] = round(osnap["mfu"], 6)
+                attrs["goodput_tok_s"] = round(osnap["goodput_tok_s"], 3)
+                attrs["padding_waste_ratio"] = round(
+                    osnap["padding_waste_ratio"], 6
+                )
             tracer.record("engine.decode", context, start_mono=t_first,
                           end_mono=end, attrs=attrs)
+
+    # --------------------- flight recorder surface ---------------------
+
+    def obs_snapshot(self) -> dict:
+        """One merged dict of live recorder gauges (stepstats window) and
+        compile-watchdog counters; {} when the recorder is disabled."""
+        if self.obs is None:
+            return {}
+        from ..observability import compilewatch
+        snap = self.obs.snapshot()
+        snap.update(compilewatch.snapshot())
+        return snap
+
+    def mark_obs_warmup_done(self) -> None:
+        """Drop warmup steps from the window and arm the steady-state
+        recompile watchdog. Call after warmup traffic has drained."""
+        if self.obs is None:
+            return
+        from ..observability import compilewatch
+        self.obs.mark_warmup_done()
+        compilewatch.mark_warmup_done()
 
     # ------------------------- step loop -------------------------------
 
@@ -867,9 +905,12 @@ class InferenceEngine(EngineCore):
                 pp_serving.init_pp_cache(model_config, engine_config),
                 pp_serving.pp_cache_shardings(self.mesh, model_config),
             )
-            self._step_fn = pp_serving.make_pp_step_fn(
-                model_config, engine_config, self.mesh,
-                engine_config.pp_microbatches,
+            self._step_fn = compilewatch.label(
+                pp_serving.make_pp_step_fn(
+                    model_config, engine_config, self.mesh,
+                    engine_config.pp_microbatches,
+                ),
+                "pp_step",
             )
             if engine_config.decode_steps > 1:
                 log.warning("decode_steps > 1 is unsupported with "
@@ -952,6 +993,23 @@ class InferenceEngine(EngineCore):
                     model_config, engine_config, self.mesh
                 )
                 self.scheduler.sp_enabled = True
+        # flight recorder: per-step MFU/goodput accounting + the compile
+        # watchdog. Records are stamped at dispatch/landing on arrays the
+        # fetcher already syncs — no extra host round-trips.
+        if env_flag("DYNTPU_OBS_ENABLED", True):
+            dev0 = self.mesh.devices.flat[0]
+            self.obs = StepStats(
+                FlopsModel(model_config),
+                n_chips=int(self.mesh.devices.size),
+                peak_flops=obs_flops.peak_flops(
+                    getattr(dev0, "device_kind", ""),
+                    getattr(dev0, "platform", "cpu"),
+                    model_config.dtype,
+                ),
+                window_s=env_float("DYNTPU_OBS_WINDOW_S", 10.0),
+                jsonl_path=env_str("DYNTPU_OBS_STEPSTATS_PATH", ""),
+            )
+            compilewatch.install()
         self._rng = jax.random.PRNGKey(seed + 1)
         self._encode_fn = None  # built lazily on the first embed()
         self._mm_ring_fn = None  # lazy (pipelined mm prefill)
@@ -983,6 +1041,8 @@ class InferenceEngine(EngineCore):
     def _shutdown_executor(self) -> None:
         self._executor.shutdown(wait=False)
         self._fetcher.stop()
+        if self.obs is not None:
+            self.obs.close()
 
     def _ap_mark_dead(self, slot: int) -> None:
         if self.pp == 1 and slot >= 0 and (
@@ -1156,11 +1216,15 @@ class InferenceEngine(EngineCore):
         could touch reused blocks. Preempted slots are marked by the loop
         at schedule() time — a batch can be empty yet carry preemptions."""
         self._ap_flush_kills()
+        obs_out = (
+            batch.obs_records if self.obs is not None
+            and hasattr(batch, "obs_records") else None
+        )
         prefill_handles = [
-            self._dispatch_prefill(c) for c in batch.prefills
+            self._dispatch_prefill(c, obs_out) for c in batch.prefills
         ]
         decode_handle = (
-            self._dispatch_decode(batch.decode_rows)
+            self._dispatch_decode(batch.decode_rows, obs_out)
             if batch.decode_rows else None
         )
         return prefill_handles, decode_handle
@@ -1223,7 +1287,27 @@ class InferenceEngine(EngineCore):
                         int(out[k, col])
                         for k in range(min(row.accepted, out.shape[0]))
                     ])
+        if self.obs is not None:
+            self._obs_on_land(batch, decode_samples)
         return prefill_samples, decode_samples
+
+    @hot_path
+    def _obs_on_land(self, batch, decode_samples) -> None:
+        """Stamp landing time + realized goodput on this window's records
+        and commit them to the flight recorder. Runs right after the
+        window's one designed device_get, on already-fetched host ints —
+        no extra syncs."""
+        recs = getattr(batch, "obs_records", None)
+        if not recs:
+            return
+        t_land = time.monotonic()
+        emitted = sum(len(w) for w in decode_samples)
+        for rec in recs:
+            rec.t_land = t_land
+            if rec.kind != PREFILL:
+                rec.goodput_tokens = emitted
+            self.obs.commit(rec)
+        recs.clear()
 
     @hot_path
     def _unpack_spec(self, batch, out, col_of) -> List[List[int]]:
@@ -1235,6 +1319,7 @@ class InferenceEngine(EngineCore):
         kk = self._spec_k
         stats = self.spec_stats
         decode_samples: List[List[int]] = []
+        win_drafted = win_accepted = 0
         for row in batch.decode_rows:
             col = col_of[row.slot]
             n = int(out[kk + 1, col])
@@ -1243,6 +1328,8 @@ class InferenceEngine(EngineCore):
             decode_samples.append([int(out[j, col]) for j in range(n_use)])
             row.seq.spec_drafted += ndraft
             row.seq.spec_accepted += max(n - 1, 0)
+            win_drafted += ndraft
+            win_accepted += max(n - 1, 0)
             stats.drafted += ndraft
             stats.accepted += max(n - 1, 0)
             stats.emitted += n_use
@@ -1250,6 +1337,11 @@ class InferenceEngine(EngineCore):
             if st is not None and st["seq_id"] == row.seq.seq_id:
                 st["pos"] = row.base + n
         stats.windows += 1
+        if self.obs is not None:
+            for rec in getattr(batch, "obs_records", ()):
+                if rec.kind == SPEC_VERIFY:
+                    rec.spec_drafted += win_drafted
+                    rec.spec_accepted += win_accepted
         th = self.config.spec_auto_disable_threshold
         if (th > 0.0 and not self._spec_auto_disabled
                 and stats.drafted >= self.config.spec_auto_disable_window
@@ -1318,7 +1410,7 @@ class InferenceEngine(EngineCore):
         ]
 
     @hot_path
-    def _dispatch_prefill(self, chunk: PrefillChunk):
+    def _dispatch_prefill(self, chunk: PrefillChunk, obs_out=None):
         """Enqueue one prefill chunk on the ring path; returns the sampled
         handle [1] (garbage unless ``chunk.final``). No host sync."""
         cfg = self.config
@@ -1331,6 +1423,17 @@ class InferenceEngine(EngineCore):
             and not seq.mm_positions  # the sp path has no mm splicing
         )
         a = self._prefill_arrays(chunk, use_sp)
+        if obs_out is not None:
+            # host-known ints only — prompt tokens are goodput at dispatch;
+            # context_sum = Σ attended context over the chunk's positions
+            L, S = chunk.length, chunk.start
+            obs_out.append(StepRecord(
+                kind=PREFILL, t_dispatch=time.monotonic(),
+                rows=1, live_rows=1,
+                padded_tokens=a["tokens"].shape[1], real_tokens=L,
+                goodput_tokens=L,
+                context_sum=L * S + L * (L + 1) // 2,
+            ))
         slot = np.array(
             [seq.slot if seq.slot >= 0 else cfg.max_num_seqs], np.int32
         )
@@ -1435,7 +1538,7 @@ class InferenceEngine(EngineCore):
         self._ctl = self._ap_delta_fn(self._ctl, di, df)
 
     @hot_path
-    def _dispatch_decode(self, rows):
+    def _dispatch_decode(self, rows, obs_out=None):
         """Enqueue one autopilot decode window. Steady state (same seats,
         no growth) dispatches with ZERO fresh host arrays — all control
         state is device-resident; the host sends packed deltas only on
@@ -1510,6 +1613,17 @@ class InferenceEngine(EngineCore):
         if self.step_sink is not None:
             self.step_sink("sw" if spec else "w", {})
         self.num_windows += 1
+        if obs_out is not None:
+            # realized goodput (emitted tokens; spec accept counts) is
+            # stamped at landing — only padded/real shapes are known here
+            ctx = sum(K * r.base + K * (K + 1) // 2 for r in rows)
+            obs_out.append(StepRecord(
+                kind=SPEC_VERIFY if spec else DECODE,
+                t_dispatch=time.monotonic(),
+                rows=B, live_rows=len(rows),
+                padded_tokens=B * K, real_tokens=len(rows) * K,
+                context_sum=ctx,
+            ))
         fn = self._spec_window_fn if spec else self._ap_window_fn
         self.cache, self._ctl, samples = fn(
             self.params, self.cache, self._ctl, self._ap_rows_dev,

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import compilewatch
 from ..parallel.layout import AXIS_SP, AXIS_TP, make_flat_mesh, make_mesh
 from .config import EngineConfig, ModelConfig
 
@@ -578,7 +579,9 @@ def encode_forward(
 
 def make_encode_fn(cfg: ModelConfig):
     """Jitted encode step: (params, tokens[B,T], positions[B,T]) -> [B, D]."""
-    return jax.jit(functools.partial(encode_forward, cfg))
+    return compilewatch.label(
+        jax.jit(functools.partial(encode_forward, cfg)), "encode"
+    )
 
 
 # ----------------------------- sampling ----------------------------------
@@ -759,7 +762,9 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
 
     params+cache carry their shardings from device_put; data args are small
     host arrays XLA replicates, so no explicit in_shardings are needed."""
-    return jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,))
+    return compilewatch.label(
+        jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,)), "step"
+    )
 
 
 # ---------------- device-resident token ring (pipelined serving) ----------
@@ -845,8 +850,11 @@ def raw_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
 def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
                           mesh: Optional[Mesh] = None):
     """Jitted ring decode window; cache and ring buffer donated."""
-    return jax.jit(
-        raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+    return compilewatch.label(
+        jax.jit(
+            raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+        ),
+        "ring_decode_window",
     )
 
 
@@ -989,10 +997,15 @@ def raw_autopilot_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
 def make_autopilot_fns(cfg: ModelConfig, eng: EngineConfig, K: int,
                        Wcap: int, mesh: Optional[Mesh] = None):
     """(window_fn, delta_fn) jitted with cache/ctl donated."""
-    window = jax.jit(
-        raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+    window = compilewatch.label(
+        jax.jit(
+            raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+        ),
+        "decode_window",
     )
-    delta = jax.jit(raw_ctl_delta_fn(Wcap), donate_argnums=(0,))
+    delta = compilewatch.label(
+        jax.jit(raw_ctl_delta_fn(Wcap), donate_argnums=(0,)), "ctl_delta"
+    )
     return window, delta
 
 
@@ -1124,11 +1137,17 @@ def make_spec_fns(cfg: ModelConfig, eng: EngineConfig, k: int,
                   ngram_min: int, ngram_max: int,
                   mesh: Optional[Mesh] = None):
     """(spec_window_fn, hist_fill_fn) jitted with cache/ctl donated."""
-    window = jax.jit(
-        raw_spec_window_fn(cfg, eng, k, ngram_min, ngram_max, mesh),
-        donate_argnums=(1, 2),
+    window = compilewatch.label(
+        jax.jit(
+            raw_spec_window_fn(cfg, eng, k, ngram_min, ngram_max, mesh),
+            donate_argnums=(1, 2),
+        ),
+        "spec_window",
     )
-    fill = jax.jit(raw_spec_hist_fill_fn(), donate_argnums=(0,))
+    fill = compilewatch.label(
+        jax.jit(raw_spec_hist_fill_fn(), donate_argnums=(0,)),
+        "spec_hist_fill",
+    )
     return window, fill
 
 
@@ -1212,8 +1231,11 @@ def raw_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
 
 def make_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                            T: int, W: int, mesh: Optional[Mesh] = None):
-    return jax.jit(
-        raw_packed_prefill_fn(cfg, eng, T, W, mesh), donate_argnums=(1, 2)
+    return compilewatch.label(
+        jax.jit(
+            raw_packed_prefill_fn(cfg, eng, T, W, mesh), donate_argnums=(1, 2)
+        ),
+        f"packed_prefill_T{T}_W{W}",
     )
 
 
@@ -1226,9 +1248,12 @@ def make_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     kw = {}
     if out_shardings is not None:
         kw["out_shardings"] = out_shardings
-    return jax.jit(
-        raw_ring_prefill_fn(cfg, eng, mesh, ring_mesh=ring_mesh),
-        donate_argnums=(1, 2), **kw,
+    return compilewatch.label(
+        jax.jit(
+            raw_ring_prefill_fn(cfg, eng, mesh, ring_mesh=ring_mesh),
+            donate_argnums=(1, 2), **kw,
+        ),
+        "sp_ring_prefill" if ring_mesh is not None else "ring_prefill",
     )
 
 
@@ -1258,7 +1283,7 @@ def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         )
         return cache, sampled
 
-    return jax.jit(step, donate_argnums=(1,))
+    return compilewatch.label(jax.jit(step, donate_argnums=(1,)), "mm_prefill")
 
 
 def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
@@ -1287,7 +1312,9 @@ def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         last_tok = last_tok.at[slot_eff].set(sampled)
         return cache, last_tok, sampled
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    return compilewatch.label(
+        jax.jit(step, donate_argnums=(1, 2)), "mm_ring_prefill"
+    )
 
 
 def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
@@ -1303,10 +1330,13 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
         cache_shardings(mesh, cfg),
         NamedSharding(mesh, P()),
     )
-    return jax.jit(
-        raw_step_fn(cfg, eng, mesh, ring_mesh=sp_mesh),
-        donate_argnums=(1,),
-        out_shardings=out_shardings,
+    return compilewatch.label(
+        jax.jit(
+            raw_step_fn(cfg, eng, mesh, ring_mesh=sp_mesh),
+            donate_argnums=(1,),
+            out_shardings=out_shardings,
+        ),
+        "sp_prefill",
     )
 
 
@@ -1360,6 +1390,8 @@ def make_kv_ops(eng: EngineConfig):
         }
 
     return (
-        jax.jit(extract),
-        jax.jit(inject, donate_argnums=(0,)),
+        compilewatch.label(jax.jit(extract), "kv_extract"),
+        compilewatch.label(
+            jax.jit(inject, donate_argnums=(0,)), "kv_inject"
+        ),
     )

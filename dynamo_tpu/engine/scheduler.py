@@ -293,6 +293,10 @@ class ScheduledBatch:
     prefills: List[PrefillChunk] = field(default_factory=list)
     decode_rows: List[DecodeRow] = field(default_factory=list)
     preempted: List[SchedSeq] = field(default_factory=list)
+    # observability: StepRecords the engine attaches at dispatch and
+    # commits at landing — riding the batch keeps attribution correct
+    # with several pipelined windows in flight
+    obs_records: List = field(default_factory=list)
 
     @property
     def decodes(self) -> List[SchedSeq]:

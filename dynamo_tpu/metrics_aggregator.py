@@ -69,6 +69,20 @@ class MetricsAggregator:
             "spec_acceptance_rate",
             "aggregate speculative-draft acceptance rate"
         )
+        # flight-recorder feed ("obs" key of the load-metrics snapshot):
+        # per-worker live MFU / goodput / padding waste
+        self._g_mfu = m.gauge(
+            "worker_mfu", "per-worker live MFU (trailing window)", ["worker"]
+        )
+        self._g_goodput = m.gauge(
+            "worker_goodput_tok_s",
+            "per-worker goodput tokens/s (trailing window)", ["worker"]
+        )
+        self._g_pad_waste = m.gauge(
+            "worker_padding_waste_ratio",
+            "per-worker fraction of dispatched FLOPs burnt on padding",
+            ["worker"]
+        )
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
@@ -152,6 +166,13 @@ class MetricsAggregator:
         drafted = spec.get("drafted", 0)
         self._g_spec_accept.labels(worker=wid).set(
             spec.get("accepted", 0) / drafted if drafted else 0.0)
+        # forward-compat: workers without the flight recorder (older build,
+        # DYNTPU_OBS_ENABLED=0, the mocker) publish no "obs" — zero-default
+        obs = snap.get("obs") or {}
+        self._g_mfu.labels(worker=wid).set(obs.get("mfu", 0.0))
+        self._g_goodput.labels(worker=wid).set(obs.get("goodput_tok_s", 0.0))
+        self._g_pad_waste.labels(worker=wid).set(
+            obs.get("padding_waste_ratio", 0.0))
         self.expire_stale()
         self._recompute_hit_rate()
         self._recompute_spec_rate()
@@ -166,7 +187,8 @@ class MetricsAggregator:
             self.worker_stats.pop(wid, None)
             self._last_seen.pop(wid, None)
             for gauge in (self._g_usage, self._g_running, self._g_waiting,
-                          self._g_spec_accept):
+                          self._g_spec_accept, self._g_mfu, self._g_goodput,
+                          self._g_pad_waste):
                 gauge.remove(worker=wid)
             log.info("expired stale worker %s from the scrape", wid)
 
@@ -217,6 +239,22 @@ class MetricsAggregator:
                        for s in self.worker_stats.values())
         return accepted / drafted if drafted else None
 
+    def _obs_mean(self, key: str):
+        """Mean of a flight-recorder field over workers that publish it
+        (None when nobody does — signals must distinguish 'no recorder'
+        from 'recorder says zero')."""
+        vals = [(s.get("obs") or {}).get(key)
+                for s in self.worker_stats.values()]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) / len(vals) if vals else None
+
+    def goodput_tok_s(self):
+        """Aggregate goodput across live workers (sum, not mean)."""
+        vals = [(s.get("obs") or {}).get("goodput_tok_s")
+                for s in self.worker_stats.values()]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) if vals else None
+
     async def _publish_signals(self, interval_s: float) -> None:
         """The aggregator's side of the planner feed: worker-queue backlog
         and aggregate spec acceptance, published like frontend_stats."""
@@ -228,6 +266,13 @@ class MetricsAggregator:
                 "queue_depth": self.queue_depth(),
                 "spec_acceptance": self.spec_acceptance(),
                 "num_workers": len(self.worker_stats),
+                # flight-recorder aggregates (None with no recorder-bearing
+                # workers): fleet-mean utilization/waste + summed goodput
+                "mfu": self._obs_mean("mfu"),
+                "padding_waste_ratio": self._obs_mean("padding_waste_ratio"),
+                "spec_reject_waste_ratio": self._obs_mean(
+                    "spec_reject_waste_ratio"),
+                "goodput_tok_s": self.goodput_tok_s(),
             }
             try:
                 await self.runtime.store.publish(

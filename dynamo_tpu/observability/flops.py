@@ -1,0 +1,124 @@
+"""The ONE analytic FLOPs/parameter model shared by the flight recorder
+(``observability/stepstats.py``) and ``bench.py``.
+
+Two terms per processed token:
+
+* **matmul**: ``2 * n_active_params`` — every weight participates in one
+  multiply-accumulate per token (2 FLOPs/MAC). The embedding *lookup* is
+  excluded (it is a gather, not a matmul); the lm_head projection is
+  included. MoE models count only the ``num_experts_per_token`` routed
+  experts as active.
+* **attention**: ``4 * num_layers * num_heads * head_dim * context`` —
+  the QK^T scores plus the PV mix, both ``num_heads * head_dim * context``
+  MACs per query token. This is the term the old ``2·N·tokens`` formula
+  dropped; at long contexts it dominates.
+
+Peak FLOP/s per chip comes from public spec sheets (dense bf16; fp32
+halves the MXU rate). The table lived in ``bench.py`` before PR 9.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+DEFAULT_PEAK = 197e12  # v5e — the BASELINE.md target platform
+CPU_PEAK = 1e12        # nominal, so CPU-fallback MFU fields stay defined
+
+
+def peak_flops(device_kind: str, platform: str,
+               dtype: str = "bfloat16") -> float:
+    """Per-chip peak FLOP/s for a device kind string (e.g. ``"TPU v5e"``).
+
+    Longest-key match over the table; unknown TPU kinds fall back to the
+    v5e number, non-TPU platforms to the nominal CPU peak. fp32 halves a
+    TPU's MXU rate (bf16 inputs are the spec-sheet number)."""
+    if platform != "tpu":
+        return CPU_PEAK
+    kind = (device_kind or "").lower()
+    peak = DEFAULT_PEAK
+    for key in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if key in kind:
+            peak = PEAK_FLOPS[key]
+            break
+    if dtype in ("float32", "f32"):
+        peak /= 2.0
+    return peak
+
+
+def param_count(cfg) -> int:
+    """Exact parameter count of ``engine.model.init_params`` for a
+    ModelConfig (checked against the real tree in test_observability)."""
+    hd = cfg.head_dim_
+    D, H, KV, F, L, V = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
+    )
+    attn = D * H * hd + 2 * D * KV * hd + H * hd * D   # wq, wk, wv, wo
+    if cfg.is_moe:
+        mlp = D * cfg.num_experts + 3 * cfg.num_experts * D * F
+    else:
+        mlp = 3 * D * F
+    per_layer = attn + mlp + 2 * D                      # + the two norms
+    total = V * D + L * per_layer + D                   # embed + final_norm
+    if not cfg.tie_word_embeddings:
+        total += D * V
+    return total
+
+
+def active_param_count(cfg) -> int:
+    """Parameters doing matmul work per token: the full count minus the
+    embedding table (gather, not matmul), with MoE expert weights scaled
+    to the ``num_experts_per_token`` actually routed."""
+    D, F, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_layers, cfg.vocab_size)
+    active = param_count(cfg) - V * D
+    if cfg.tie_word_embeddings:
+        # the tied table still runs as the lm_head matmul
+        active += D * V
+    if cfg.is_moe and cfg.num_experts > cfg.num_experts_per_token:
+        inactive_experts = cfg.num_experts - cfg.num_experts_per_token
+        active -= L * inactive_experts * 3 * D * F
+    return active
+
+
+class FlopsModel:
+    """Per-step forward-FLOPs estimator for one ModelConfig.
+
+    ``step_flops(tokens, context_sum)`` = matmul term + attention term,
+    where ``context_sum`` is the sum over the step's tokens of the context
+    length each token attends (position + 1)."""
+
+    def __init__(self, model_cfg):
+        self.model_cfg = model_cfg
+        self.n_params = param_count(model_cfg)
+        self.n_active_params = active_param_count(model_cfg)
+        self.matmul_per_token = 2.0 * self.n_active_params
+        # QK^T + PV: 2 matmuls of (num_heads*head_dim x context) per token
+        self.attn_coef = (4.0 * model_cfg.num_layers * model_cfg.num_heads
+                          * model_cfg.head_dim_)
+
+    def step_flops(self, tokens: float, context_sum: float) -> float:
+        return self.matmul_per_token * tokens + self.attn_coef * context_sum
+
+    def sequence_context_sum(self, length: int, start: int = 0) -> int:
+        """Sum of (position + 1) over positions [start, start+length) —
+        the ``context_sum`` of prefilling those tokens causally."""
+        if length <= 0:
+            return 0
+        return length * start + length * (length + 1) // 2
+
+    def sequence_flops(self, isl: int, osl: int) -> float:
+        """Total forward FLOPs to serve one (isl, osl) request: prefill
+        the prompt plus decode osl tokens, attention term included."""
+        total = isl + osl
+        return self.step_flops(total, self.sequence_context_sum(total))

@@ -1,0 +1,72 @@
+"""On-demand ``jax.profiler`` capture behind ``/debug/profile?ms=N``.
+
+One capture at a time per process (the profiler is a global); traces land
+in a fresh TensorBoard-loadable directory under the configured base dir
+(``DYNTPU_OBS_PROFILE_DIR``, default the system temp dir). CPU-safe: the
+JAX profiler produces a (host-only) trace without an accelerator, which
+is what the smoke test exercises.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+import threading
+import time
+
+from ..utils.logging import get_logger
+
+log = get_logger("observability.profile")
+
+DEFAULT_MS = 1000
+MAX_MS = 30_000
+
+_capture_lock = threading.Lock()  # one capture per process, ever
+
+
+def default_base_dir() -> str:
+    return os.environ.get(
+        "DYNTPU_OBS_PROFILE_DIR",
+        os.path.join(tempfile.gettempdir(), "dyntpu-profiles"),
+    )
+
+
+class ProfileBusyError(RuntimeError):
+    """A capture is already running in this process."""
+
+
+async def capture(ms: int, base_dir: str = "") -> dict:
+    """Capture a ``ms``-millisecond profiler trace; returns metadata
+    (``trace_dir`` is TensorBoard-loadable:
+    ``tensorboard --logdir <trace_dir>``). Raises :class:`ProfileBusyError`
+    when a capture is already in flight."""
+    ms = max(1, min(int(ms), MAX_MS))
+    base = base_dir or default_base_dir()
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfileBusyError("a profile capture is already running")
+    try:
+        os.makedirs(base, exist_ok=True)
+        trace_dir = tempfile.mkdtemp(
+            prefix=time.strftime("trace-%Y%m%d-%H%M%S-"), dir=base
+        )
+        import jax
+
+        t0 = time.monotonic()
+        jax.profiler.start_trace(trace_dir)
+        try:
+            # DT301: the wait must yield the event loop — the engine keeps
+            # serving (that's the point: profile it under load)
+            await asyncio.sleep(ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        wall_ms = (time.monotonic() - t0) * 1000.0
+    finally:
+        _capture_lock.release()
+    log.info("profiler trace captured to %s (%.0f ms)", trace_dir, wall_ms)
+    return {
+        "trace_dir": trace_dir,
+        "requested_ms": ms,
+        "captured_ms": round(wall_ms, 1),
+        "tensorboard": f"tensorboard --logdir {trace_dir}",
+    }

@@ -104,13 +104,14 @@ class WorkerMetricsPublisher:
 
     def __init__(
         self, component: Component, worker_id: int, stats_fn,
-        interval_s: float = 1.0, extra_fn=None, spec_fn=None,
+        interval_s: float = 1.0, extra_fn=None, spec_fn=None, obs_fn=None,
     ):
         self.component = component
         self.worker_id = worker_id
         self.stats_fn = stats_fn      # () -> SchedulerStats
         self.extra_fn = extra_fn      # () -> dict merged into the snapshot
         self.spec_fn = spec_fn        # () -> SpecDecodeStats dict ("spec" key)
+        self.obs_fn = obs_fn          # () -> flight-recorder dict ("obs" key)
         self.interval_s = interval_s
         self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
         self._task: Optional[asyncio.Task] = None
@@ -145,6 +146,13 @@ class WorkerMetricsPublisher:
                 snap["spec"] = dict(self.spec_fn())
             except Exception:
                 log.exception("metrics spec_fn failed")
+        if self.obs_fn is not None:
+            try:
+                obs = self.obs_fn()
+                if obs:
+                    snap["obs"] = dict(obs)
+            except Exception:
+                log.exception("metrics obs_fn failed")
         return snap
 
     async def _pump(self) -> None:

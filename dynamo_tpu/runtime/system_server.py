@@ -57,6 +57,7 @@ class SystemServer:
             web.get("/live", self._livez),
             web.post("/drain", self._drain),
             web.get("/metrics", self._metrics),
+            web.get("/debug/profile", self._profile),
             web.get("/debug/traces", self._traces),
             web.get("/debug/traces/{trace_id}", self._trace),
         ])
@@ -113,9 +114,37 @@ class SystemServer:
                                  status=200 if self._live else 503)
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        from prometheus_client import CONTENT_TYPE_LATEST
+
         body = self.metrics.render() if self.metrics else b""
-        return web.Response(body=body, content_type="text/plain",
-                            charset="utf-8")
+        # exposition-format content type (text/plain; version=0.0.4) so
+        # conformant scrapers negotiate the right parser
+        return web.Response(body=body,
+                            headers={"Content-Type": CONTENT_TYPE_LATEST})
+
+    async def _profile(self, request: web.Request) -> web.Response:
+        """On-demand device profile: ``GET /debug/profile?ms=N`` captures a
+        ``jax.profiler`` trace for N ms (clamped) into a TensorBoard-loadable
+        directory and returns its path. One capture at a time per process;
+        concurrent requests get 409."""
+        from ..observability import profiling
+
+        try:
+            ms = int(request.query.get("ms", profiling.DEFAULT_MS))
+        except ValueError:
+            return web.json_response(
+                {"error": "ms must be an integer"}, status=400
+            )
+        try:
+            result = await profiling.capture(
+                ms, base_dir=request.query.get("dir", "")
+            )
+        except profiling.ProfileBusyError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:  # profiler unavailable on this backend
+            log.exception("profile capture failed")
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(result)
 
     async def _traces(self, request: web.Request) -> web.Response:
         """Recent trace ids still resident in this process's span buffer."""

@@ -65,9 +65,19 @@ async def serve_engine(
     kv_pub.start()
     engine.kv_event_sink = kv_pub.sink
     st = getattr(engine, "spec_stats", None)
+    # flight recorder: worker-local engine_* gauges on /metrics, and the
+    # same snapshot rides the load-metrics wire ("obs" key) so the
+    # aggregator gets per-worker MFU/goodput/waste for planner signals
+    obs_fn = None
+    if getattr(engine, "obs", None) is not None:
+        from .observability.gauges import EngineObsGauges
+
+        obs_gauges = EngineObsGauges(runtime.metrics, engine)
+        obs_fn = obs_gauges.refresh
     metrics_pub = WorkerMetricsPublisher(
         endpoint.component, runtime.primary_lease, lambda: engine.stats,
         spec_fn=st.to_dict if st is not None else None,
+        obs_fn=obs_fn,
     )
     metrics_pub.start()
 

@@ -118,6 +118,20 @@ class RuntimeConfig:
     # workers poll planner/{ns}/degradation and clamp their engine knobs
     # when enabled (frontends always apply tier shedding)
     planner_apply_degradation: bool = False
+    # -- engine flight recorder (dynamo_tpu.observability) --
+    # master switch for the per-step recorder + compile watchdog; the
+    # recorder stamps host-known ints on already-planned syncs, so the
+    # steady-state overhead is a few microseconds per window
+    obs_enabled: bool = True
+    # trailing window the live gauges (engine_mfu, engine_goodput_tok_s,
+    # engine_padding_waste_ratio, ...) describe
+    obs_window_s: float = 10.0
+    # append every landed StepRecord as one JSON line here ("" disables);
+    # render offline with `python -m dynamo_tpu.observability <path>`
+    obs_stepstats_path: str = ""
+    # base directory for /debug/profile?ms=N trace captures ("" = a
+    # dyntpu-profiles dir under the system tempdir)
+    obs_profile_dir: str = ""
 
     @staticmethod
     def from_settings(path: Optional[str] = None) -> "RuntimeConfig":
@@ -221,6 +235,18 @@ class RuntimeConfig:
         cfg.planner_apply_degradation = env_flag(
             ENV_PREFIX + "PLANNER_APPLY_DEGRADATION",
             cfg.planner_apply_degradation,
+        )
+        cfg.obs_enabled = env_flag(
+            ENV_PREFIX + "OBS_ENABLED", cfg.obs_enabled
+        )
+        cfg.obs_window_s = env_float(
+            ENV_PREFIX + "OBS_WINDOW_S", cfg.obs_window_s
+        )
+        cfg.obs_stepstats_path = env_str(
+            ENV_PREFIX + "OBS_STEPSTATS_PATH", cfg.obs_stepstats_path
+        )
+        cfg.obs_profile_dir = env_str(
+            ENV_PREFIX + "OBS_PROFILE_DIR", cfg.obs_profile_dir
         )
         return cfg
 

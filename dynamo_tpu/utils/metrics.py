@@ -103,6 +103,30 @@ class MetricsRegistry:
         return generate_latest(self.registry)
 
 
+def validate_exposition(body: bytes) -> list:
+    """Round-trip a text-exposition payload through the reference parser
+    and return the parsed sample tuples.
+
+    Raises ``ValueError`` on any conformance violation (unescaped label
+    values or HELP text, malformed sample lines, duplicate series) — the
+    scrape-and-validate test runs every registry through this, so nasty
+    label values (newlines, quotes, backslashes) can't silently corrupt
+    the exposition.
+    """
+    from prometheus_client.parser import text_string_to_metric_families
+
+    samples = []
+    seen = set()
+    for fam in text_string_to_metric_families(body.decode("utf-8")):
+        for s in fam.samples:
+            key = (s.name, tuple(sorted(s.labels.items())))
+            if key in seen:
+                raise ValueError(f"duplicate series {key!r}")
+            seen.add(key)
+            samples.append(s)
+    return samples
+
+
 class _Bound:
     """Partially-bound metric: const labels applied, extra labels at call time."""
 

@@ -16,6 +16,9 @@
 #   scripts/verify.sh lint        # dynalint static analysis (--check) +
 #                                 # analyzer unit tests; echoes the repro
 #                                 # line on failure
+#   scripts/verify.sh obs         # engine flight recorder suite (stepstats
+#                                 # invariants, compile watchdog, /debug/
+#                                 # profile smoke, report golden)
 set -u
 
 cd "$(dirname "$0")/.."
@@ -32,6 +35,11 @@ fi
 
 if [ "${1:-}" = "kernel" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernel \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "obs" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m observability \
         -p no:cacheprovider
 fi
 

@@ -1,0 +1,581 @@
+"""Engine flight recorder suite (PR 9, `-m observability`).
+
+Covers the shared FLOPs model (parameter-count parity against the real
+``init_params`` tree), stepstats windowed invariants, the compile-and-remat
+watchdog (including the acceptance criterion: steady-state recompiles stay
+flat after warmup while a seeded shape change is detected AND attributed
+to its jitted function), the /debug/profile endpoint, Prometheus text
+exposition conformance, aggregator forward-compat + stale expiry for the
+new per-worker gauges, and the offline report CLI golden.
+"""
+
+import dataclasses
+import json
+import logging
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine import model as model_lib
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.observability import compilewatch
+from dynamo_tpu.observability import flops as flops_lib
+from dynamo_tpu.observability.flops import (
+    FlopsModel, active_param_count, param_count, peak_flops,
+)
+from dynamo_tpu.observability.gauges import EngineObsGauges
+from dynamo_tpu.observability.report import load_records, render_report
+from dynamo_tpu.observability.stepstats import (
+    DECODE, PREFILL, SPEC_VERIFY, StepRecord, StepStats,
+)
+from dynamo_tpu.utils.metrics import MetricsRegistry, validate_exposition
+
+pytestmark = pytest.mark.observability
+
+
+# ---------------------------------------------------------------------------
+# FLOPs model
+# ---------------------------------------------------------------------------
+
+def _real_param_count(cfg: ModelConfig) -> int:
+    params = model_lib.init_params(jax.random.PRNGKey(0), cfg)
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+@pytest.mark.parametrize("cfg_name", ["tiny", "tiny_moe", "tiny_tied"])
+def test_param_count_matches_init_params(cfg_name):
+    """The analytic count is EXACT against the real parameter tree —
+    dense, MoE, and tied-embedding variants."""
+    if cfg_name == "tiny":
+        cfg = ModelConfig.tiny()
+    elif cfg_name == "tiny_moe":
+        cfg = ModelConfig.tiny_moe()
+    else:
+        cfg = dataclasses.replace(ModelConfig.tiny(),
+                                  tie_word_embeddings=True)
+    assert param_count(cfg) == _real_param_count(cfg)
+
+
+def test_active_param_count_excludes_gather_includes_lm_head():
+    cfg = ModelConfig.tiny()
+    # untied: active = total - embedding table (lm_head already counted)
+    assert (active_param_count(cfg)
+            == param_count(cfg) - cfg.vocab_size * cfg.hidden_size)
+    tied = dataclasses.replace(cfg, tie_word_embeddings=True)
+    # tied: the one table is both gather (excluded) and lm_head (included),
+    # so active matches the untied case exactly
+    assert active_param_count(tied) == active_param_count(cfg)
+
+
+def test_flops_model_attention_term():
+    """step_flops = 2·active·tokens + 4·L·H·hd·context_sum — the attention
+    term the old 2·N·tokens bench formula dropped."""
+    cfg = ModelConfig.tiny()
+    fm = FlopsModel(cfg)
+    attn_coef = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim_
+    assert fm.attn_coef == attn_coef
+    assert fm.step_flops(10, 0) == pytest.approx(
+        2.0 * active_param_count(cfg) * 10)
+    assert fm.step_flops(0, 100) == pytest.approx(attn_coef * 100)
+    assert fm.step_flops(10, 100) == pytest.approx(
+        fm.step_flops(10, 0) + fm.step_flops(0, 100))
+    # causal prefill context sum: positions start..start+len-1 attend pos+1
+    assert fm.sequence_context_sum(4, start=0) == 1 + 2 + 3 + 4
+    assert fm.sequence_context_sum(3, start=10) == 11 + 12 + 13
+    assert fm.sequence_context_sum(0) == 0
+    # longer context must cost strictly more than the matmul-only estimate
+    assert fm.sequence_flops(128, 32) > fm.matmul_per_token * 160
+
+
+def test_peak_flops_table():
+    assert peak_flops("TPU v5e", "tpu") == 197e12
+    assert peak_flops("TPU v5p", "tpu") == 459e12
+    # v5p must not be swallowed by the shorter "v5" key
+    assert peak_flops("TPU v6e", "tpu") == 918e12
+    # fp32 halves the MXU rate
+    assert peak_flops("TPU v5e", "tpu", "float32") == 197e12 / 2
+    # unknown TPU kind -> v5e default; non-TPU -> nominal CPU peak
+    assert peak_flops("TPU v9x", "tpu") == flops_lib.DEFAULT_PEAK
+    assert peak_flops("", "cpu") == flops_lib.CPU_PEAK
+
+
+# ---------------------------------------------------------------------------
+# StepStats
+# ---------------------------------------------------------------------------
+
+def _mk_stats(tmp_path=None, **kw):
+    clock = {"t": 100.0}
+    kw.setdefault("n_chips", 1)
+    kw.setdefault("peak_flops", 1e9)
+    kw.setdefault("window_s", 10.0)
+    stats = StepStats(FlopsModel(ModelConfig.tiny()),
+                      clock=lambda: clock["t"], **kw)
+    return stats, clock
+
+
+def test_stepstats_window_invariants():
+    stats, clock = _mk_stats()
+    rec = StepRecord(kind=PREFILL, t_dispatch=100.0, t_land=100.1,
+                     rows=1, live_rows=1, padded_tokens=32, real_tokens=20,
+                     goodput_tokens=20, context_sum=210)
+    stats.commit(rec)
+    # commit fills the FLOPs fields from the shared model
+    fm = stats.flops_model
+    assert rec.flops_real == pytest.approx(fm.step_flops(20, 210))
+    assert rec.flops_dispatched == pytest.approx(
+        fm.step_flops(32, 210 * 32 / 20))
+    assert rec.flops_goodput == rec.flops_real  # goodput == real tokens
+    clock["t"] = 101.0
+    snap = stats.snapshot(max_age_s=0.0)
+    assert snap["steps_in_window"] == 1
+    assert snap["goodput_tok_s"] == pytest.approx(20.0)  # 20 tok / 1 s
+    assert 0.0 < snap["padding_waste_ratio"] < 1.0
+    assert snap["padding_waste_ratio"] == pytest.approx(
+        (rec.flops_dispatched - rec.flops_real) / rec.flops_dispatched)
+    assert snap["spec_reject_waste_ratio"] == 0.0
+    # all-goodput prefill: mfu == mfu_prefill, decode share is zero
+    assert snap["mfu"] == pytest.approx(snap["mfu_prefill"])
+    assert snap["mfu_decode"] == 0.0
+    assert snap["mfu"] == pytest.approx(
+        rec.flops_goodput / (1.0 * stats.peak_flops))
+    assert snap["mfu_dispatched"] > snap["mfu"]
+
+
+def test_stepstats_spec_waste_split():
+    stats, clock = _mk_stats()
+    # spec verify window: 25 real tokens computed, only 15 advanced seqs
+    stats.commit(StepRecord(kind=SPEC_VERIFY, t_dispatch=100.0, t_land=100.2,
+                            rows=8, live_rows=5, padded_tokens=40,
+                            real_tokens=25, goodput_tokens=15,
+                            context_sum=500, spec_drafted=20,
+                            spec_accepted=10))
+    clock["t"] = 100.5
+    snap = stats.snapshot(max_age_s=0.0)
+    assert snap["spec_reject_waste_ratio"] > 0.0
+    assert snap["padding_waste_ratio"] > 0.0
+    # waste ratios + goodput fraction partition dispatched FLOPs
+    goodput_frac = snap["mfu"] / snap["mfu_dispatched"]
+    assert (snap["padding_waste_ratio"] + snap["spec_reject_waste_ratio"]
+            + goodput_frac) == pytest.approx(1.0)
+    assert snap["spec_drafted"] == 20 and snap["spec_accepted"] == 10
+
+
+def test_stepstats_window_pruning_and_warmup_reset():
+    stats, clock = _mk_stats(window_s=10.0)
+    stats.commit(StepRecord(kind=DECODE, t_dispatch=100.0, t_land=100.1,
+                            padded_tokens=8, real_tokens=4,
+                            goodput_tokens=4, context_sum=40))
+    clock["t"] = 105.0
+    assert stats.snapshot(max_age_s=0.0)["steps_in_window"] == 1
+    clock["t"] = 120.0  # landing now older than window_s
+    snap = stats.snapshot(max_age_s=0.0)
+    assert snap["steps_in_window"] == 0
+    assert snap["goodput_tok_s"] == 0.0
+    # lifetime totals survive the window rollover...
+    assert snap["total_steps"] == 1
+    # ...but not the warmup reset
+    stats.mark_warmup_done()
+    snap = stats.snapshot(max_age_s=0.0)
+    assert snap["total_steps"] == 0 and snap["total_goodput_tokens"] == 0
+
+
+def test_stepstats_snapshot_cache():
+    stats, clock = _mk_stats()
+    a = stats.snapshot(max_age_s=10.0)
+    stats.commit(StepRecord(kind=DECODE, t_dispatch=100.0, t_land=100.0,
+                            padded_tokens=8, real_tokens=8,
+                            goodput_tokens=8, context_sum=8))
+    # a commit invalidates the cache even inside max_age_s
+    b = stats.snapshot(max_age_s=10.0)
+    assert a["steps_in_window"] == 0 and b["steps_in_window"] == 1
+
+
+def test_stepstats_jsonl_capture(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    stats, clock = _mk_stats(jsonl_path=str(path))
+    stats.commit(StepRecord(kind=PREFILL, t_dispatch=100.0, t_land=100.1,
+                            padded_tokens=16, real_tokens=5,
+                            goodput_tokens=5, context_sum=15))
+    stats.commit(StepRecord(kind=DECODE, t_dispatch=100.1, t_land=100.2,
+                            padded_tokens=8, real_tokens=2,
+                            goodput_tokens=2, context_sum=12))
+    stats.close()
+    with open(path) as fh:
+        records = load_records(fh)
+    assert [r["kind"] for r in records] == [PREFILL, DECODE]
+    # FLOPs fields were filled before serialization
+    assert all(r["flops_dispatched"] > 0 for r in records)
+
+
+# ---------------------------------------------------------------------------
+# Compile watchdog
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def watch():
+    compilewatch.install()
+    w = compilewatch.get_watch()
+    w.reset()
+    yield w
+    w.reset()
+
+
+def test_compilewatch_attribution_and_steady_state(watch):
+    # build inputs up front: array creation itself compiles incidental
+    # fill helpers, which belong in warmup (the <unattributed> bucket)
+    a4, z4, b8 = (jnp.ones((4,), jnp.float32), jnp.zeros((4,), jnp.float32),
+                  jnp.ones((8,), jnp.float32))
+    fn = compilewatch.label(jax.jit(lambda x: x * 2 + 1), "obs_test_dbl")
+    fn(a4).block_until_ready()
+    assert watch.snapshot()["compiles_by_fn"].get("obs_test_dbl") == 1
+    assert watch.compile_secs["obs_test_dbl"] > 0.0
+    # cache hit: same shape compiles nothing
+    fn(z4).block_until_ready()
+    assert watch.snapshot()["compiles_by_fn"]["obs_test_dbl"] == 1
+    watch.mark_warmup_done()
+    fn(a4).block_until_ready()
+    assert watch.steady_total() == 0
+    # the seeded shape change is detected AND attributed to its function
+    fn(b8).block_until_ready()
+    assert watch.steady_by_label() == {"obs_test_dbl": 1}
+    snap = watch.snapshot()
+    assert snap["recompiles_steady_state"] == 1
+    assert snap["recompiles_by_fn"] == {"obs_test_dbl": 1}
+
+
+def test_compilewatch_label_preserves_callable(watch):
+    jitted = jax.jit(lambda x: x + 1)
+    wrapped = compilewatch.label(jitted, "obs_test_add")
+    assert wrapped.__wrapped__ is jitted
+    assert wrapped.__compile_label__ == "obs_test_add"
+    out = wrapped(jnp.asarray([1, 2], jnp.int32))
+    assert out.tolist() == [2, 3]
+
+
+def test_assert_no_recompiles_helper(watch):
+    fn = compilewatch.label(jax.jit(lambda x: x - 3), "obs_test_sub")
+    fn(jnp.ones((4,), jnp.float32)).block_until_ready()
+    with compilewatch.assert_no_recompiles():
+        fn(jnp.zeros((4,), jnp.float32)).block_until_ready()
+    with pytest.raises(AssertionError, match="obs_test_sub"):
+        with compilewatch.assert_no_recompiles():
+            fn(jnp.ones((16,), jnp.float32)).block_until_ready()
+
+
+def test_remat_warning_parsing(watch):
+    text = ("W0000 [SPMD] Involuntary full rematerialization of f32[2048]\n"
+            "noise\n"
+            "w1234 [spmd] involuntary full rematerialization again\n")
+    assert compilewatch.scan_log_text(text) == 2
+    assert watch.snapshot()["involuntary_remats_total"] == 2
+    # warnings that reach Python logging (jax/absl bridges) count too
+    logging.getLogger("jax").warning(
+        "[SPMD] Involuntary full rematerialization of %s", "f32[8,128]")
+    assert watch.snapshot()["involuntary_remats_total"] == 3
+    # steady-state counter only ticks after the warmup mark
+    assert watch.snapshot()["involuntary_remats_steady"] == 0
+    watch.mark_warmup_done()
+    compilewatch.scan_log_text("[SPMD] Involuntary full rematerialization")
+    snap = watch.snapshot()
+    assert snap["involuntary_remats_total"] == 4
+    assert snap["involuntary_remats_steady"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: the acceptance criterion
+# ---------------------------------------------------------------------------
+
+async def _run(engine, prompt, n=4):
+    req = Request(request_id=f"obs-{prompt[0]}-{len(prompt)}-{n}",
+                  token_ids=prompt, max_tokens=n, temperature=0.0,
+                  ignore_eos=True)
+    return [out.token_id async for out in engine.submit(req)]
+
+
+@pytest.mark.anyio
+async def test_engine_steady_state_recompiles_flat_then_seeded_shape(watch):
+    """ISSUE 9 acceptance: after warmup, engine_recompiles_total stays flat
+    over same-shape traffic; a seeded shape change (a prompt spilling into
+    the next prefill bucket) is detected and attributed to its jitted fn."""
+    engine = InferenceEngine(
+        ModelConfig.tiny(),
+        EngineConfig(
+            block_size=4, num_blocks=64, max_num_seqs=4,
+            max_num_batched_tokens=64, max_model_len=128,
+            decode_buckets=(8,), prefill_buckets=(16, 32),
+        ),
+    )
+    assert engine.obs is not None  # recorder on by default
+    await engine.start()
+    try:
+        # warmup: two requests in the T=16 prefill bucket
+        assert len(await _run(engine, [5, 6, 7, 8, 9])) == 4
+        assert len(await _run(engine, [9, 8, 7])) == 4
+        assert watch.snapshot()["compiles_total"] > 0
+        engine.mark_obs_warmup_done()
+
+        # steady state: identical shapes — the recorder must stay flat
+        assert len(await _run(engine, [1, 2, 3, 4, 5])) == 4
+        snap = engine.obs_snapshot()
+        assert snap["recompiles_steady_state"] == 0
+        assert snap["recompiles_by_fn"] == {}
+        # the five live fields bench.py reports come from this snapshot
+        assert snap["total_steps"] > 0
+        assert snap["goodput_tok_s"] > 0.0
+        assert snap["mfu"] > 0.0 and snap["mfu_prefill"] > 0.0
+        assert 0.0 <= snap["padding_waste_ratio"] < 1.0
+
+        # seeded shape change: a prompt that needs the T=32 bucket
+        assert len(await _run(engine, list(range(2, 22)))) == 4
+        steady = watch.steady_by_label()
+        assert any(fn.startswith("packed_prefill_T32") for fn in steady), (
+            f"seeded recompile not attributed: {steady!r}")
+        assert engine.obs_snapshot()["recompiles_steady_state"] >= 1
+    finally:
+        await engine.stop()
+
+
+@pytest.mark.anyio
+async def test_engine_obs_spans_and_gauges(watch):
+    """EngineObsGauges mints the engine_* series and returns a scalar-only
+    wire dict for the load-metrics publisher."""
+    engine = InferenceEngine(
+        ModelConfig.tiny(),
+        EngineConfig(block_size=4, num_blocks=64, max_num_seqs=4,
+                     max_num_batched_tokens=64, max_model_len=128,
+                     decode_buckets=(8,), prefill_buckets=(16,)),
+    )
+    await engine.start()
+    try:
+        await _run(engine, [3, 1, 4, 1, 5])
+        registry = MetricsRegistry()
+        gauges = EngineObsGauges(registry, engine)
+        wire = gauges.refresh()
+        assert wire["goodput_tok_s"] > 0.0
+        assert wire["recompiles_steady_state"] == 0
+        # non-scalar snapshot entries (per-fn dicts) stay off the wire
+        assert all(isinstance(v, (int, float)) for v in wire.values())
+        body = registry.render()
+        names = {s.name for s in validate_exposition(body)}
+        for expect in ("dynamo_engine_mfu", "dynamo_engine_mfu_by_class",
+                       "dynamo_engine_goodput_tok_s",
+                       "dynamo_engine_padding_waste_ratio",
+                       "dynamo_engine_wasted_flops_ratio",
+                       "dynamo_engine_involuntary_remats_total"):
+            assert expect in names, f"{expect} missing from exposition"
+    finally:
+        await engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/profile + /metrics conformance over HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.mark.anyio
+async def test_profile_endpoint_and_metrics_content_type(tmp_path):
+    import os
+
+    import aiohttp
+    from prometheus_client import CONTENT_TYPE_LATEST
+
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    metrics = MetricsRegistry()
+    metrics.gauge("obs_demo_gauge", "demo").set(1.5)
+    server = SystemServer(metrics=metrics, host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                    f"{base}/debug/profile",
+                    params={"ms": "50", "dir": str(tmp_path)}) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+            assert os.path.isdir(data["trace_dir"])
+            assert data["trace_dir"].startswith(str(tmp_path))
+            assert data["requested_ms"] == 50
+            assert data["captured_ms"] >= 50
+            async with sess.get(f"{base}/debug/profile",
+                                params={"ms": "oops"}) as resp:
+                assert resp.status == 400
+            async with sess.get(f"{base}/metrics") as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == CONTENT_TYPE_LATEST
+                body = await resp.read()
+        samples = validate_exposition(body)
+        assert any(s.name == "dynamo_obs_demo_gauge" and s.value == 1.5
+                   for s in samples)
+    finally:
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_profile_busy_returns_409():
+    import asyncio
+
+    from dynamo_tpu.observability import profiling
+
+    # hold the capture lock as a concurrent capture would
+    assert profiling._capture_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(profiling.ProfileBusyError):
+            await profiling.capture(10)
+    finally:
+        profiling._capture_lock.release()
+    _ = asyncio
+
+
+def test_prometheus_exposition_nasty_label_values():
+    """Label values with newlines, quotes, and backslashes must round-trip
+    the reference parser unchanged — the escaping satellite."""
+    registry = MetricsRegistry()
+    g = registry.gauge("obs_nasty_gauge", 'help with "quotes" and \\slash',
+                       ["fn"])
+    nasty = ['line\nbreak', 'quo"te', 'back\\slash', 'plain']
+    for i, val in enumerate(nasty):
+        g.labels(fn=val).set(float(i))
+    samples = validate_exposition(registry.render())
+    seen = {s.labels["fn"]: s.value for s in samples
+            if s.name == "dynamo_obs_nasty_gauge"}
+    assert seen == {val: float(i) for i, val in enumerate(nasty)}
+
+
+# ---------------------------------------------------------------------------
+# Aggregator forward-compat + stale expiry
+# ---------------------------------------------------------------------------
+
+def _agg(clock):
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+
+    metrics = MetricsRegistry()
+    runtime = SimpleNamespace(
+        metrics=metrics,
+        namespace=lambda *a, **k: SimpleNamespace(
+            component=lambda name: SimpleNamespace(
+                event_subject=lambda s: f"x.{name}.{s}")),
+    )
+    return MetricsAggregator(runtime, "backend", stale_after_s=30.0,
+                             clock=lambda: clock["t"]), metrics
+
+
+def test_aggregator_obs_forward_compat_and_expiry():
+    clock = {"t": 1000.0}
+    agg, metrics = _agg(clock)
+    # new-style worker publishes "obs"; old-style worker omits it entirely
+    agg._on_stats({"worker_id": "w-new", "kv_usage": 0.5,
+                   "obs": {"mfu": 0.4, "goodput_tok_s": 120.0,
+                           "padding_waste_ratio": 0.25,
+                           "spec_reject_waste_ratio": 0.05}})
+    agg._on_stats({"worker_id": "w-old", "kv_usage": 0.1})
+    samples = validate_exposition(metrics.render())
+    by_series = {(s.name, s.labels.get("worker")): s.value for s in samples}
+    assert by_series[("dynamo_worker_mfu", "w-new")] == 0.4
+    assert by_series[("dynamo_worker_goodput_tok_s", "w-new")] == 120.0
+    assert by_series[("dynamo_worker_padding_waste_ratio", "w-new")] == 0.25
+    # forward-compat: the obs-less worker reads zero, not KeyError
+    assert by_series[("dynamo_worker_mfu", "w-old")] == 0.0
+    # planner-signal aggregates: mean over publishers, goodput summed;
+    # the obs-less worker does NOT drag the mean down
+    assert agg._obs_mean("mfu") == pytest.approx(0.4)
+    assert agg.goodput_tok_s() == pytest.approx(120.0)
+
+    # stale expiry clears the new per-worker label sets too
+    clock["t"] = 1031.0
+    agg._on_stats({"worker_id": "w-new", "kv_usage": 0.5,
+                   "obs": {"mfu": 0.4, "goodput_tok_s": 120.0,
+                           "padding_waste_ratio": 0.25}})
+    samples = validate_exposition(metrics.render())
+    workers = {s.labels.get("worker") for s in samples
+               if s.name in ("dynamo_worker_mfu",
+                             "dynamo_worker_goodput_tok_s",
+                             "dynamo_worker_padding_waste_ratio")}
+    assert workers == {"w-new"}, f"stale worker gauges leaked: {workers}"
+
+
+def test_aggregator_obs_mean_none_without_recorders():
+    clock = {"t": 1000.0}
+    agg, _ = _agg(clock)
+    agg._on_stats({"worker_id": "w-old", "kv_usage": 0.1})
+    # signals must distinguish "no recorder" (None) from "recorder says 0"
+    assert agg._obs_mean("mfu") is None
+    assert agg.goodput_tok_s() is None
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+def test_runtime_config_obs_env_knobs(monkeypatch, tmp_path):
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    cfg = RuntimeConfig()
+    assert cfg.obs_enabled is True
+    assert cfg.obs_window_s == 10.0
+    assert cfg.obs_stepstats_path == "" and cfg.obs_profile_dir == ""
+    monkeypatch.setenv("DYNTPU_OBS_ENABLED", "0")
+    monkeypatch.setenv("DYNTPU_OBS_WINDOW_S", "5.5")
+    monkeypatch.setenv("DYNTPU_OBS_STEPSTATS_PATH",
+                       str(tmp_path / "steps.jsonl"))
+    monkeypatch.setenv("DYNTPU_OBS_PROFILE_DIR", str(tmp_path / "traces"))
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.obs_enabled is False
+    assert cfg.obs_window_s == 5.5
+    assert cfg.obs_stepstats_path == str(tmp_path / "steps.jsonl")
+    assert cfg.obs_profile_dir == str(tmp_path / "traces")
+
+
+# ---------------------------------------------------------------------------
+# Offline report CLI
+# ---------------------------------------------------------------------------
+
+_REPORT_RECORDS = [
+    {"kind": "prefill", "t_dispatch": 0.0, "t_land": 0.2,
+     "padded_tokens": 32, "real_tokens": 20, "goodput_tokens": 20,
+     "flops_dispatched": 3200.0, "flops_real": 2000.0,
+     "flops_goodput": 2000.0},
+    {"kind": "decode", "t_dispatch": 0.2, "t_land": 0.5,
+     "padded_tokens": 16, "real_tokens": 8, "goodput_tokens": 8,
+     "flops_dispatched": 1600.0, "flops_real": 800.0,
+     "flops_goodput": 800.0},
+    {"kind": "spec_verify", "t_dispatch": 0.5, "t_land": 1.0,
+     "padded_tokens": 40, "real_tokens": 25, "goodput_tokens": 15,
+     "spec_drafted": 20, "spec_accepted": 10,
+     "flops_dispatched": 4000.0, "flops_real": 2500.0,
+     "flops_goodput": 1500.0},
+]
+
+_REPORT_GOLDEN = """\
+engine flight recorder — where did the time go
+==============================================================
+records: 3   wall: 1.000s   goodput: 43 tok (43.0 tok/s)
+
+class         steps      tok  pad tok   busy s  share  waste
+--------------------------------------------------------------
+decode            1        8        8    0.300  18.2%  50.0%
+prefill           1       20       12    0.200  36.4%  37.5%
+spec_verify       1       15       15    0.500  45.5%  62.5%
+--------------------------------------------------------------
+padding waste:      39.8% of dispatched FLOPs
+spec-reject waste:  11.4% of dispatched FLOPs
+goodput FLOPs:      48.9% of dispatched
+spec acceptance:   10/20 (50.0%)
+"""
+
+
+def test_report_golden():
+    assert render_report(list(_REPORT_RECORDS)) == _REPORT_GOLDEN
+    assert render_report([]) == "no step records\n"
+
+
+def test_report_cli_main(tmp_path, capsys):
+    from dynamo_tpu.observability.report import main
+
+    path = tmp_path / "steps.jsonl"
+    path.write_text(
+        "".join(json.dumps(r) + "\n" for r in _REPORT_RECORDS))
+    assert main([str(path)]) == 0
+    assert capsys.readouterr().out == _REPORT_GOLDEN
