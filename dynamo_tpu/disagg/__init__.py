@@ -8,18 +8,29 @@ transfer plane into those pre-allocated slots, and resumes decoding from the
 remotely-sampled first token. TPU-native data plane: jitted block
 gather/scatter (``engine.model.make_kv_ops``) host-relayed over the TCP
 transport; same-mesh transfers ride ICI through the identical jitted ops.
+
+Fault model: reservations are epoch-guarded (stale transfers rejected
+before write, see ``ici.StaleEpochError``), relay frames are
+integrity-checked (``protocol.KvIntegrityError``), and repeated handoff
+failures trip a breaker that flips decode to local-prefill for a cooldown
+window. See README "Operations" for the full cascade.
 """
 
 from .handlers import (
     DecodeHandler, DisaggConfig, PrefillHandler, PrefillQueueWorker,
 )
-from .protocol import kv_from_wire, kv_to_wire
+from .ici import DevicePlane, StaleEpochError, default_plane
+from .protocol import KvIntegrityError, kv_from_wire, kv_to_wire
 
 __all__ = [
     "DecodeHandler",
+    "DevicePlane",
     "DisaggConfig",
+    "KvIntegrityError",
     "PrefillHandler",
     "PrefillQueueWorker",
+    "StaleEpochError",
+    "default_plane",
     "kv_from_wire",
     "kv_to_wire",
 ]

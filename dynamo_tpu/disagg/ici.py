@@ -48,6 +48,11 @@ from ..utils.logging import get_logger
 
 log = get_logger("disagg.ici")
 
+
+class StaleEpochError(RuntimeError):
+    """The destination reservation was recycled (or resumed) before the
+    transfer landed — writing now would corrupt another request's KV."""
+
 # data layout produced by the jitted extract: [L, N, KV, bs, hd];
 # KV heads (axis 2) carry the tensor-parallel sharding.
 _DATA_SPEC = P(None, None, AXIS_TP, None, None)
@@ -79,13 +84,21 @@ class DevicePlane:
         return self._engines.get(plane_id)
 
     async def transfer(
-        self, src_engine, src_block_ids, dst_engine, dst_block_ids
+        self, src_engine, src_block_ids, dst_engine, dst_block_ids,
+        *, dst_seq_id: Optional[str] = None, dst_epoch: Optional[int] = None,
     ) -> int:
         """Move whole KV blocks src→dst on device. Returns bytes moved.
 
         Block id lists are padded to the same power of two: source pads
         gather the trash block, destination pads scatter back into the
         trash block, so no host-side slicing is ever needed.
+
+        When ``dst_seq_id``/``dst_epoch`` are given, the destination
+        reservation is re-validated *inside the scatter callable* — i.e. on
+        the destination engine's executor thread, immediately before the
+        donated write — and a stale epoch raises :class:`StaleEpochError`
+        without touching the cache. This closes the query-then-write TOCTOU
+        window a host-side liveness check leaves open.
         """
         n = len(src_block_ids)
         if len(dst_block_ids) != n:
@@ -113,6 +126,12 @@ class DevicePlane:
             data = jax.device_put(data, {"k": sharding, "v": sharding})
 
         def _scatter():
+            if dst_epoch is not None and not dst_engine.reservation_valid(
+                dst_seq_id, dst_epoch
+            ):
+                raise StaleEpochError(
+                    f"reservation {dst_seq_id!r} epoch {dst_epoch} is stale"
+                )
             dst_engine.cache = dst_engine._kv_inject(
                 dst_engine.cache, dst_ids, data
             )

@@ -2,10 +2,18 @@
 (ref: the ``kv_transfer_params`` dict threaded through handlers.py:147-188
 and the block-ID-only descriptor design of disagg_serving.md §Efficient KV
 Transfer — metadata rides the control message; bulk bytes ride the
-transport's binary frames)."""
+transport's binary frames).
+
+Every frame carries an integrity envelope: the byte length implied by
+``shape``/``dtype`` plus a CRC32 over each tensor's raw bytes. The decode
+side verifies the envelope *before* scattering into reserved blocks, so a
+truncated, bit-flipped, or dtype-mangled relay payload is rejected (the
+handoff falls back / retries) instead of poisoning the KV cache.
+"""
 
 from __future__ import annotations
 
+import zlib
 from typing import Dict
 
 import numpy as np
@@ -18,25 +26,56 @@ except Exception:  # pragma: no cover
     _DTYPES = {}
 
 
+class KvIntegrityError(ValueError):
+    """Wire payload failed its size/dtype/checksum verification."""
+
+
 def _np_dtype(name: str) -> np.dtype:
-    return _DTYPES.get(name, np.dtype(name))
+    try:
+        return _DTYPES.get(name, np.dtype(name))
+    except TypeError as exc:
+        raise KvIntegrityError(f"unknown KV dtype {name!r}") from exc
 
 
 def kv_to_wire(data: Dict[str, np.ndarray]) -> dict:
-    """{"k","v"} arrays -> msgpack-safe dict (raw bytes + shape + dtype)."""
+    """{"k","v"} arrays -> msgpack-safe dict (raw bytes + shape + dtype +
+    per-tensor CRC32)."""
     k, v = data["k"], data["v"]
+    kb, vb = k.tobytes(), v.tobytes()
     return {
         "shape": list(k.shape),
         "dtype": k.dtype.name,
-        "k": k.tobytes(),
-        "v": v.tobytes(),
+        "k": kb,
+        "v": vb,
+        "k_crc": zlib.crc32(kb),
+        "v_crc": zlib.crc32(vb),
     }
 
 
+def _verify(name: str, buf: bytes, nbytes: int, crc) -> None:
+    if len(buf) != nbytes:
+        raise KvIntegrityError(
+            f"{name} payload is {len(buf)} bytes, expected {nbytes}"
+        )
+    if crc is not None and zlib.crc32(buf) != int(crc):
+        raise KvIntegrityError(f"{name} payload failed its checksum")
+
+
 def kv_from_wire(wire: dict) -> Dict[str, np.ndarray]:
-    shape = tuple(wire["shape"])
+    """Decode and *verify* a wire frame. Raises :class:`KvIntegrityError`
+    on truncation, checksum mismatch, or a dtype/shape that doesn't match
+    the byte payload — never returns a partially-valid tensor pair.
+
+    Frames without ``k_crc``/``v_crc`` (older peers) still get the
+    size check; the checksum is skipped.
+    """
+    shape = tuple(int(d) for d in wire["shape"])
     dt = _np_dtype(wire["dtype"])
+    nbytes = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+    kb, vb = wire["k"], wire["v"]
+    _verify("k", kb, nbytes, wire.get("k_crc"))
+    _verify("v", vb, nbytes, wire.get("v_crc"))
     return {
-        "k": np.frombuffer(wire["k"], dtype=dt).reshape(shape),
-        "v": np.frombuffer(wire["v"], dtype=dt).reshape(shape),
+        "k": np.frombuffer(kb, dtype=dt).reshape(shape),
+        "v": np.frombuffer(vb, dtype=dt).reshape(shape),
     }

@@ -213,6 +213,11 @@ class EngineCore(AsyncEngine):
         self._ids = itertools.count(1)
         self.kv_event_sink: Optional[Callable[[dict], None]] = None
         self._pending_events: List[dict] = []
+        # disagg reservation epochs: seq_id -> epoch while the reservation
+        # is live (reserve_sequence .. resume_prefilled/cancel_reservation).
+        # Transfers stamped with an older epoch are rejected before write.
+        self._kv_epoch = itertools.count(1)
+        self._kv_reservations: Dict[str, int] = {}
         self.kvbm = None  # multi-tier block manager (attach_kvbm)
         # run-ahead depth: how many scheduled windows may be in flight
         # before the loop waits for a landing. 1 = classic synchronous
@@ -402,7 +407,23 @@ class EngineCore(AsyncEngine):
         self._seqs[seq.seq_id] = seq
         self.scheduler.add(seq)
         self._wake.set()
-        out = await queue.get()
+        try:
+            out = await queue.get()
+        except asyncio.CancelledError:
+            # Hard-cancelled mid-prefill (queue worker killed, caller
+            # torn down): the held handle never reaches the caller, so
+            # nobody can release_held — drop the hold ourselves. With
+            # hold_blocks cleared, _finish/reap free the blocks the
+            # moment no in-flight window can still scatter into them.
+            seq.hold_blocks = False
+            if seq.status == SeqStatus.FINISHED:
+                if seq.pending_total == 0 and seq not in self.scheduler.zombies:
+                    self.scheduler.release_held(seq)
+            else:
+                self.abort(seq.seq_id, "cancelled")
+            self._queues.pop(seq.seq_id, None)
+            self._seqs.pop(seq.seq_id, None)
+            raise
         if out.finish_reason not in ("length", "stop"):
             self.release_held(seq)
             raise RuntimeError(
@@ -432,14 +453,27 @@ class EngineCore(AsyncEngine):
         )
         if not self.scheduler.reserve(seq):
             return None
+        # epoch-guard the reservation: any transfer targeting these blocks
+        # must present this epoch, so a delayed write aimed at a recycled
+        # reservation (same seq id, new blocks) is rejected, not scattered
+        seq.kv_epoch = next(self._kv_epoch)
+        self._kv_reservations[seq.seq_id] = seq.kv_epoch
         self._queues[seq.seq_id] = asyncio.Queue()
         self._seqs[seq.seq_id] = seq
         return seq
 
     def cancel_reservation(self, seq: SchedSeq) -> None:
+        self._kv_reservations.pop(seq.seq_id, None)
         self.scheduler.release_held(seq)  # reserved blocks, same release
         self._queues.pop(seq.seq_id, None)
         self._seqs.pop(seq.seq_id, None)
+
+    def reservation_valid(self, seq_id: str, epoch: int) -> bool:
+        """True while ``seq_id``'s reservation is live *and* carries
+        ``epoch``. Both the device-plane scatter and the wire-relay inject
+        check this immediately before writing; it also tells the orphan
+        sweeper a reservation is still safe to cancel."""
+        return self._kv_reservations.get(seq_id) == epoch
 
     async def resume_prefilled(
         self, seq: SchedSeq, first_token: int
@@ -447,6 +481,9 @@ class EngineCore(AsyncEngine):
         """Decode-worker side: activate a reserved sequence whose KV was
         injected; streams from the remotely-sampled first token onward."""
         await self.start()
+        # the reservation window closes here: late transfers must not write
+        # into a sequence that is actively decoding
+        self._kv_reservations.pop(seq.seq_id, None)
         self.scheduler.admit_prefilled(seq, first_token)
         self._emit_token(seq)
         self._wake.set()
@@ -1077,10 +1114,15 @@ class InferenceEngine(EngineCore):
         return await loop.run_in_executor(self._executor, _ex)
 
     async def inject_kv_blocks(
-        self, block_ids, data: Dict[str, np.ndarray]
+        self, block_ids, data: Dict[str, np.ndarray],
+        *, seq_id: Optional[str] = None, epoch: Optional[int] = None,
     ) -> None:
         """Scatter per-block KV into physical blocks (pads scatter into the
-        trash block, which absorbs garbage by design)."""
+        trash block, which absorbs garbage by design).
+
+        With ``seq_id``/``epoch`` the reservation is re-validated *inside*
+        the executor callable — immediately before the donated write — so a
+        reservation recycled mid-flight is rejected, never scattered."""
         if self._kv_inject is None:
             raise RuntimeError("KV block transfer unsupported on a "
                                "pipeline-parallel engine")
@@ -1099,6 +1141,12 @@ class InferenceEngine(EngineCore):
             }
 
         def _in():
+            if epoch is not None and not self.reservation_valid(seq_id, epoch):
+                from ..disagg.ici import StaleEpochError
+
+                raise StaleEpochError(
+                    f"reservation {seq_id!r} epoch {epoch} is stale"
+                )
             self.cache = self._kv_inject(self.cache, padded, data)
 
         await loop.run_in_executor(self._executor, _in)
@@ -1107,9 +1155,12 @@ class InferenceEngine(EngineCore):
         """Gather a held sequence's KV blocks to host memory."""
         return await self.extract_kv_blocks(seq.block_table)
 
-    async def inject_kv(self, seq, data: Dict[str, np.ndarray]) -> None:
+    async def inject_kv(self, seq, data: Dict[str, np.ndarray],
+                        epoch: Optional[int] = None) -> None:
         """Scatter received KV into a reserved sequence's blocks."""
-        await self.inject_kv_blocks(seq.block_table, data)
+        await self.inject_kv_blocks(
+            seq.block_table, data, seq_id=seq.seq_id, epoch=epoch
+        )
 
     # ----------------------- embeddings (encode) -----------------------
 

@@ -223,6 +223,10 @@ class SchedSeq:
     # disagg: keep blocks alive after finish until the KV is extracted
     # (prefill worker side; released via Scheduler.release_held)
     hold_blocks: bool = False
+    # disagg: reservation epoch stamped by EngineCore.reserve_sequence —
+    # a transfer carrying a stale epoch must never scatter into these
+    # blocks (they may have been recycled to another request)
+    kv_epoch: int = 0
     # ---- pipelined (run-ahead) serving state ----
     # device token-ring slot (-1 = unassigned); see model.raw_decode_window_fn
     slot: int = -1

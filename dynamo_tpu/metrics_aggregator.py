@@ -83,6 +83,26 @@ class MetricsAggregator:
             "per-worker fraction of dispatched FLOPs burnt on padding",
             ["worker"]
         )
+        # disagg handoff health ("disagg" key of the snapshot): fallbacks,
+        # breaker state, transfer retries, orphan reaps
+        self._g_dg_fallbacks = m.gauge(
+            "disagg_fallback_total",
+            "per-worker remote-prefill failures that fell back to local",
+            ["worker"]
+        )
+        self._g_dg_breaker = m.gauge(
+            "disagg_breaker_open",
+            "1 while the worker's handoff breaker is open "
+            "(local-prefill cooldown)", ["worker"]
+        )
+        self._g_dg_retries = m.gauge(
+            "disagg_transfer_retries_total",
+            "per-worker KV push retry attempts", ["worker"]
+        )
+        self._g_dg_orphans = m.gauge(
+            "disagg_orphans_reaped_total",
+            "per-worker deadline-expired handoff entries reaped", ["worker"]
+        )
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
@@ -173,6 +193,16 @@ class MetricsAggregator:
         self._g_goodput.labels(worker=wid).set(obs.get("goodput_tok_s", 0.0))
         self._g_pad_waste.labels(worker=wid).set(
             obs.get("padding_waste_ratio", 0.0))
+        # forward-compat: non-disagg workers publish no "disagg" — zero
+        dg = snap.get("disagg") or {}
+        self._g_dg_fallbacks.labels(worker=wid).set(
+            dg.get("fallback_total", 0.0))
+        self._g_dg_breaker.labels(worker=wid).set(
+            dg.get("breaker_open", 0.0))
+        self._g_dg_retries.labels(worker=wid).set(
+            dg.get("transfer_retries_total", 0.0))
+        self._g_dg_orphans.labels(worker=wid).set(
+            dg.get("orphans_reaped_total", 0.0))
         self.expire_stale()
         self._recompute_hit_rate()
         self._recompute_spec_rate()
@@ -188,7 +218,9 @@ class MetricsAggregator:
             self._last_seen.pop(wid, None)
             for gauge in (self._g_usage, self._g_running, self._g_waiting,
                           self._g_spec_accept, self._g_mfu, self._g_goodput,
-                          self._g_pad_waste):
+                          self._g_pad_waste, self._g_dg_fallbacks,
+                          self._g_dg_breaker, self._g_dg_retries,
+                          self._g_dg_orphans):
                 gauge.remove(worker=wid)
             log.info("expired stale worker %s from the scrape", wid)
 

@@ -725,3 +725,359 @@ async def run_scenario(sc: SimScenario, workdir: str) -> dict:
     await cluster.shutdown()
     await stores["live"].stop()
     return report
+
+
+# ----------------------- disagg chaos harness ---------------------------
+# Real tiny InferenceEngines (CPU JAX) wired exactly like a disaggregated
+# P/D worker pair — real kv_inject TCP ingress, real store work queue in
+# queue mode — driven through a seeded FaultPlan storm. The invariants it
+# certifies are the ones ROADMAP item 3 leans on: byte parity with the
+# local-prefill path, zero KV corruption (poisoned-block canary), and zero
+# leaked blocks/reservations after the storm.
+
+
+@dataclass
+class DisaggChaosScenario:
+    """One seeded disagg chaos run. ``plan_fn(plan)`` installs the fault
+    rules; structural events (prefill-worker kill) are fields."""
+
+    name: str
+    seed: int = 0
+    num_requests: int = 6
+    concurrency: int = 2
+    use_queue: bool = False
+    # hide the device plane from the prefill side → every transfer rides
+    # the integrity-checked host relay
+    relay_only: bool = False
+    prompt_len: Tuple[int, int] = (24, 40)
+    max_tokens: int = 6
+    queue_wait_s: float = 4.0
+    handoff_timeout_s: float = 10.0
+    inject_timeout_s: float = 2.0
+    transfer_max_retries: int = 3
+    retry_backoff_base_s: float = 0.02
+    inflight_grace_s: float = 4.0
+    min_remote_prefill_tokens: int = 8
+    breaker_failure_threshold: int = 100  # storms shouldn't trip by default
+    plan_fn: Optional[object] = None      # Callable[[FaultPlan], None]
+    # queue mode: hard-kill the queue worker once it pulled this many
+    # items (mid-transfer when combined with a disagg.transfer delay)
+    kill_prefill_after_pulls: Optional[int] = None
+    revive_prefill: bool = True
+
+
+class _InlinePrefillClient:
+    """Push-mode stand-in for the component Client: routes straight to the
+    in-process PrefillHandler (transport ingress is still real for the KV
+    inject leg, which is the leg the faults target)."""
+
+    def __init__(self, handler):
+        self.handler = handler
+
+    def instance_ids(self):
+        return [1]
+
+    def round_robin(self, request, context):
+        return self.handler.generate(request, Context())
+
+
+class DisaggChaosHarness:
+    """Builds the P/D pair, plants the canary, runs the storm, accounts
+    for every block. Use :func:`run_disagg_scenario` for the one-shot
+    form."""
+
+    def __init__(self, sc: DisaggChaosScenario):
+        self.sc = sc
+        self._canary_seq = None
+        self._canary_pattern = None
+        self._free_baseline: Dict[str, int] = {}
+
+    async def start(self) -> None:
+        from ..disagg.handlers import (
+            DecodeHandler, DisaggConfig, PrefillHandler, PrefillQueueWorker,
+        )
+        from ..disagg.ici import DevicePlane
+        from ..engine.config import EngineConfig, ModelConfig
+        from ..engine.engine import InferenceEngine
+        from ..runtime.store import StoreClient
+        from ..runtime.transport import IngressServer
+
+        sc = self.sc
+        model_cfg = ModelConfig.tiny(vocab_size=256)
+        eng_cfg = EngineConfig(
+            num_blocks=64, block_size=4, max_model_len=128,
+            max_num_batched_tokens=128, prefill_buckets=(128,),
+            decode_buckets=(4,), max_num_seqs=4,
+        )
+        # identical init seeds: the remote-prefill path, the local-fallback
+        # path, and the serial reference must all be greedy-identical
+        self.prefill_engine = InferenceEngine(model_cfg, eng_cfg, seed=0)
+        self.decode_engine = InferenceEngine(model_cfg, eng_cfg, seed=0)
+        self.reference_engine = InferenceEngine(model_cfg, eng_cfg, seed=0)
+
+        plane = DevicePlane()
+        self.config = DisaggConfig(
+            min_remote_prefill_tokens=sc.min_remote_prefill_tokens,
+            use_queue=sc.use_queue, queue_name=f"chaos_q_{sc.seed}",
+            queue_wait_s=sc.queue_wait_s,
+            handoff_timeout_s=sc.handoff_timeout_s,
+            inflight_grace_s=sc.inflight_grace_s,
+            inject_timeout_s=sc.inject_timeout_s,
+            transfer_max_retries=sc.transfer_max_retries,
+            retry_backoff_base_s=sc.retry_backoff_base_s,
+            breaker_failure_threshold=sc.breaker_failure_threshold,
+            orphan_sweep_interval_s=0.5, orphan_grace_s=0.5,
+        )
+        self.prefill_handler = PrefillHandler(
+            self.prefill_engine,
+            plane=DevicePlane() if sc.relay_only else plane,
+            config=self.config,
+        )
+        self.store_server = None
+        self.queue_worker = None
+        self._stores = []
+        if sc.use_queue:
+            self.store_server = StoreServer(host="127.0.0.1", port=0)
+            await self.store_server.start()
+            addr = f"127.0.0.1:{self.store_server.port}"
+            prefill_store = await StoreClient.connect(addr)
+            decode_store = await StoreClient.connect(addr)
+            self._stores = [prefill_store, decode_store]
+            self.queue_worker = PrefillQueueWorker(
+                self.prefill_handler, prefill_store,
+                queue_name=self.config.queue_name,
+            )
+            self.queue_worker.start()
+            prefill_client = None
+            store = decode_store
+        else:
+            prefill_client = _InlinePrefillClient(self.prefill_handler)
+            store = None
+        self.decode_handler = DecodeHandler(
+            self.decode_engine, prefill_client=prefill_client,
+            config=self.config, plane=plane, store=store,
+        )
+        self.inject_server = IngressServer(
+            self.decode_handler.inject_handler(), host="127.0.0.1", port=0
+        )
+        await self.inject_server.start()
+        self.decode_handler.kv_inject_addr = (
+            f"127.0.0.1:{self.inject_server.port}"
+        )
+        await self._plant_canary()
+        self._free_baseline = {
+            "prefill": self.prefill_engine.scheduler.pool.num_free,
+            "decode": self.decode_engine.scheduler.pool.num_free,
+        }
+
+    async def stop(self) -> None:
+        if self.queue_worker is not None:
+            await self.queue_worker.stop()
+        if hasattr(self.prefill_handler, "_transport"):
+            await self.prefill_handler._transport.close()
+        self.decode_handler.close()
+        self.prefill_handler.close()
+        await self.inject_server.stop()
+        for engine in (self.prefill_engine, self.decode_engine,
+                       self.reference_engine):
+            await engine.stop()
+        for s in self._stores:
+            await s.close()
+        if self.store_server is not None:
+            await self.store_server.stop()
+
+    # ----------------- poisoned-block canary ---------------------------
+
+    async def _plant_canary(self) -> None:
+        import numpy as np
+
+        from ..engine.engine import Request
+
+        req = Request(request_id="canary", token_ids=list(range(1, 18)),
+                      max_tokens=1)
+        seq = self.decode_engine.reserve_sequence(req)
+        assert seq is not None, "canary reservation must fit"
+        probe = await self.decode_engine.extract_kv_blocks(seq.block_table)
+        self._canary_pattern = {
+            "k": np.full(probe["k"].shape, 3.0, probe["k"].dtype),
+            "v": np.full(probe["v"].shape, -5.0, probe["v"].dtype),
+        }
+        await self.decode_engine.inject_kv_blocks(
+            seq.block_table, self._canary_pattern
+        )
+        self._canary_seq = seq
+
+    async def _canary_corrupted(self) -> bool:
+        import numpy as np
+
+        got = await self.decode_engine.extract_kv_blocks(
+            self._canary_seq.block_table
+        )
+        ok = (np.array_equal(np.asarray(got["k"], np.float32),
+                             np.asarray(self._canary_pattern["k"], np.float32))
+              and np.array_equal(
+                  np.asarray(got["v"], np.float32),
+                  np.asarray(self._canary_pattern["v"], np.float32)))
+        return not ok
+
+    # ------------------------- the storm --------------------------------
+
+    async def run(self) -> dict:
+        from ..runtime import faults
+        from ..runtime.faults import FaultPlan
+
+        sc = self.sc
+        rng = random.Random(sc.seed)
+        prompts = [
+            [rng.randrange(1, 255)
+             for _ in range(rng.randint(*sc.prompt_len))]
+            for _ in range(sc.num_requests)
+        ]
+        requests = [
+            {"token_ids": p, "max_tokens": sc.max_tokens,
+             "ignore_eos": True}
+            for p in prompts
+        ]
+        # serial greedy reference BEFORE any fault is installed
+        expected = []
+        for r in requests:
+            expected.append(await self._collect(
+                self.reference_engine.generate(dict(r), Context())
+            ))
+
+        plan = FaultPlan(seed=sc.seed)
+        if sc.plan_fn is not None:
+            sc.plan_fn(plan)
+        faults.install(plan)
+        killer = None
+        if sc.kill_prefill_after_pulls is not None:
+            killer = asyncio.create_task(self._kill_prefill())
+        sem = asyncio.Semaphore(sc.concurrency)
+        results: List[Optional[List[int]]] = [None] * sc.num_requests
+
+        async def _one(i: int) -> None:
+            async with sem:
+                await asyncio.sleep(rng.random() * 0.05)
+                try:
+                    results[i] = await asyncio.wait_for(
+                        self._collect(self.decode_handler.generate(
+                            dict(requests[i]),
+                            Context(request_id=f"chaos{sc.seed}-{i}"),
+                        )),
+                        timeout=60.0,
+                    )
+                except Exception:
+                    log.exception("chaos request %d died", i)
+
+        try:
+            await asyncio.gather(*(_one(i) for i in range(sc.num_requests)))
+        finally:
+            faults.clear()
+            if killer is not None:
+                killer.cancel()
+                await asyncio.gather(killer, return_exceptions=True)
+        # Quiesce before measuring: stop the queue worker so in-flight
+        # prefills receive their cancellations NOW (not at teardown), then
+        # wait for sweeps/zombie-reaps to return both pools to baseline.
+        # A real leak never converges and still fails the assertion below.
+        if self.queue_worker is not None:
+            await self.queue_worker.stop()
+        for _ in range(50):
+            self.decode_handler.sweep_orphans()
+            self.prefill_handler.sweep_orphans()
+            quiesced = (
+                not self.decode_handler.pending
+                and not self.prefill_handler._held
+                and not self.prefill_engine.scheduler.zombies
+                and not self.prefill_engine.scheduler.running
+                and (self.prefill_engine.scheduler.pool.num_free
+                     == self._free_baseline["prefill"])
+                and (self.decode_engine.scheduler.pool.num_free
+                     == self._free_baseline["decode"])
+            )
+            if quiesced:
+                break
+            await asyncio.sleep(0.2)
+
+        parity_failures = sum(
+            1 for got, want in zip(results, expected) if got != want
+        )
+        completed = sum(1 for got in results if got is not None)
+        leaked_pending = (len(self.decode_handler.pending)
+                          + len(self.prefill_handler._held))
+        # the canary is the only reservation allowed to survive the storm
+        leaked_reservations = (
+            len(self.decode_engine._kv_reservations)
+            - (1 if self._canary_seq is not None else 0)
+        )
+        canary_corrupted = await self._canary_corrupted()
+        leaked_prefill = (self._free_baseline["prefill"]
+                          - self.prefill_engine.scheduler.pool.num_free)
+        leaked_decode = (self._free_baseline["decode"]
+                         - self.decode_engine.scheduler.pool.num_free)
+        leaked_blocks = leaked_prefill + leaked_decode
+        self.decode_engine.cancel_reservation(self._canary_seq)
+        dh, ph = self.decode_handler, self.prefill_handler
+        return {
+            "name": sc.name,
+            "seed": sc.seed,
+            "num_requests": sc.num_requests,
+            "completed": completed,
+            "parity_failures": parity_failures,
+            "remote_prefills": dh.num_remote_prefills,
+            "local_prefills": dh.num_local_prefills,
+            "fallbacks": dh.num_fallbacks,
+            "transfer_retries": ph.num_transfer_retries,
+            "epoch_rejects": dh.num_epoch_rejects,
+            "integrity_rejects": dh.num_integrity_rejects,
+            "orphans_reaped": (dh.num_orphans_reaped
+                               + ph.num_orphans_reaped),
+            "queue_expired": (self.queue_worker.num_expired
+                              if self.queue_worker is not None else 0),
+            "breaker_trips": dh.fallback_breaker.num_trips,
+            "faults_fired": plan.fired(),
+            "canary_corrupted": canary_corrupted,
+            "leaked_blocks": leaked_blocks,
+            "leaked_blocks_prefill": leaked_prefill,
+            "leaked_blocks_decode": leaked_decode,
+            "leaked_pending": leaked_pending,
+            "leaked_reservations": leaked_reservations,
+        }
+
+    async def _kill_prefill(self) -> None:
+        """Hard-kill the queue worker once it pulled enough items (pair
+        with a disagg.transfer delay to make the kill land mid-transfer),
+        then optionally revive a fresh worker so the storm can recover."""
+        from ..disagg.handlers import PrefillQueueWorker
+
+        sc = self.sc
+        while (self.queue_worker is None
+               or self.queue_worker.num_pulled < sc.kill_prefill_after_pulls):
+            await asyncio.sleep(0.01)
+        pulled = self.queue_worker.num_pulled
+        await self.queue_worker.stop()
+        log.info("chaos: killed prefill queue worker after %d pulls", pulled)
+        if sc.revive_prefill:
+            await asyncio.sleep(0.3)
+            self.queue_worker = PrefillQueueWorker(
+                self.prefill_handler, self._stores[0],
+                queue_name=self.config.queue_name,
+            )
+            self.queue_worker.start()
+
+    @staticmethod
+    async def _collect(stream) -> List[int]:
+        toks: List[int] = []
+        async for out in stream:
+            toks.extend(out["token_ids"])
+        return toks
+
+
+async def run_disagg_scenario(sc: DisaggChaosScenario) -> dict:
+    """One-shot: build the harness, run the storm, tear everything down."""
+    h = DisaggChaosHarness(sc)
+    await h.start()
+    try:
+        return await h.run()
+    finally:
+        await h.stop()

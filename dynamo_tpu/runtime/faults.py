@@ -19,6 +19,10 @@ site                        key
 ``store.call``              store op name (``put``, ``publish``, …)
 ``store.connect``           store ``host:port`` being (re)dialled
 ``store.watch``             watched key prefix at (re)subscribe time
+``disagg.prefill``          request id, at remote-prefill execution start
+``disagg.transfer``         request id, per KV push attempt (device or
+                            relay; ``truncate`` corrupts the relay frame)
+``disagg.inject``           request id arriving at the kv_inject ingress
 ==========================  =============================================
 
 Kinds and how sites interpret them:

@@ -118,6 +118,27 @@ class RuntimeConfig:
     # workers poll planner/{ns}/degradation and clamp their engine knobs
     # when enabled (frontends always apply tier shedding)
     planner_apply_degradation: bool = False
+    # -- disaggregated prefill/decode handoff (dynamo_tpu.disagg) --
+    # how long decode waits on a queued prefill before going local
+    disagg_queue_wait_s: float = 60.0
+    # total wall budget for one KV handoff (further capped by the
+    # request's own deadline)
+    disagg_handoff_timeout_s: float = 120.0
+    # extra wait when a device transfer is mid-write at timeout
+    disagg_inflight_grace_s: float = 30.0
+    # per-attempt cap on one KV push (device transfer or relay inject)
+    disagg_inject_timeout_s: float = 10.0
+    # push retries after the first attempt (exponential backoff from
+    # the base, always bounded by the remaining handoff deadline)
+    disagg_transfer_max_retries: int = 2
+    disagg_retry_backoff_base_s: float = 0.05
+    # consecutive handoff failures before decode flips to local-prefill
+    # for the cooldown window (exported as disagg_breaker_open)
+    disagg_breaker_failure_threshold: int = 3
+    disagg_breaker_cooldown_s: float = 10.0
+    # orphan GC: sweep cadence + slack past an entry's deadline
+    disagg_orphan_sweep_interval_s: float = 5.0
+    disagg_orphan_grace_s: float = 5.0
     # -- engine flight recorder (dynamo_tpu.observability) --
     # master switch for the per-step recorder + compile watchdog; the
     # recorder stamps host-known ints on already-planned syncs, so the
@@ -235,6 +256,44 @@ class RuntimeConfig:
         cfg.planner_apply_degradation = env_flag(
             ENV_PREFIX + "PLANNER_APPLY_DEGRADATION",
             cfg.planner_apply_degradation,
+        )
+        cfg.disagg_queue_wait_s = env_float(
+            ENV_PREFIX + "DISAGG_QUEUE_WAIT_S", cfg.disagg_queue_wait_s
+        )
+        cfg.disagg_handoff_timeout_s = env_float(
+            ENV_PREFIX + "DISAGG_HANDOFF_TIMEOUT_S",
+            cfg.disagg_handoff_timeout_s,
+        )
+        cfg.disagg_inflight_grace_s = env_float(
+            ENV_PREFIX + "DISAGG_INFLIGHT_GRACE_S",
+            cfg.disagg_inflight_grace_s,
+        )
+        cfg.disagg_inject_timeout_s = env_float(
+            ENV_PREFIX + "DISAGG_INJECT_TIMEOUT_S",
+            cfg.disagg_inject_timeout_s,
+        )
+        cfg.disagg_transfer_max_retries = env_int(
+            ENV_PREFIX + "DISAGG_TRANSFER_MAX_RETRIES",
+            cfg.disagg_transfer_max_retries,
+        )
+        cfg.disagg_retry_backoff_base_s = env_float(
+            ENV_PREFIX + "DISAGG_RETRY_BACKOFF_BASE_S",
+            cfg.disagg_retry_backoff_base_s,
+        )
+        cfg.disagg_breaker_failure_threshold = env_int(
+            ENV_PREFIX + "DISAGG_BREAKER_FAILURE_THRESHOLD",
+            cfg.disagg_breaker_failure_threshold,
+        )
+        cfg.disagg_breaker_cooldown_s = env_float(
+            ENV_PREFIX + "DISAGG_BREAKER_COOLDOWN_S",
+            cfg.disagg_breaker_cooldown_s,
+        )
+        cfg.disagg_orphan_sweep_interval_s = env_float(
+            ENV_PREFIX + "DISAGG_ORPHAN_SWEEP_INTERVAL_S",
+            cfg.disagg_orphan_sweep_interval_s,
+        )
+        cfg.disagg_orphan_grace_s = env_float(
+            ENV_PREFIX + "DISAGG_ORPHAN_GRACE_S", cfg.disagg_orphan_grace_s
         )
         cfg.obs_enabled = env_flag(
             ENV_PREFIX + "OBS_ENABLED", cfg.obs_enabled

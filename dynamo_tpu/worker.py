@@ -306,12 +306,15 @@ async def run_worker(args: argparse.Namespace) -> None:
     queue_worker = None
     component = args.component
     if args.disagg_mode == "prefill":
-        from .disagg import PrefillHandler, PrefillQueueWorker
+        from .disagg import DisaggConfig, PrefillHandler, PrefillQueueWorker
 
         # prefill workers serve on their own component; decode workers own
         # model registration (ref: vllm main.py:137 init_prefill)
         component = args.prefill_component
-        handler = PrefillHandler(engine)
+        handler = PrefillHandler(
+            engine, config=DisaggConfig.from_runtime(config)
+        )
+        handler.start_orphan_sweeper()
         if args.disagg_queue:
             queue_worker = PrefillQueueWorker(
                 handler, runtime.store, queue_name=args.disagg_queue_name
@@ -327,13 +330,15 @@ async def run_worker(args: argparse.Namespace) -> None:
         )
         handler = DecodeHandler(
             engine, prefill_client,
-            DisaggConfig(
+            DisaggConfig.from_runtime(
+                config,
                 min_remote_prefill_tokens=args.min_remote_prefill_tokens,
                 use_queue=args.disagg_queue,
                 queue_name=args.disagg_queue_name,
             ),
             store=runtime.store,
         )
+        handler.start_orphan_sweeper()
 
     mm_opts = None
     mm_handler = None
@@ -366,9 +371,12 @@ async def run_worker(args: argparse.Namespace) -> None:
     served, kv_pub, metrics_pub = await serve_engine(
         runtime, engine, eng_cfg, opts, tokenizer, handler=handler
     )
-    if args.disagg_mode == "decode" and args.disagg_queue:
-        # surface the prefill backlog to the planner via load metrics
+    if args.disagg_mode in ("prefill", "decode"):
+        # surface the disagg health gauges (fallbacks, breaker state,
+        # retries, orphan reaps — and in queue mode the prefill backlog)
+        # to the planner via load metrics
         metrics_pub.extra_fn = handler.metrics_extra
+    if args.disagg_mode == "decode" and args.disagg_queue:
         handler.start_depth_monitor()
     if args.disagg_mode == "decode":
         inject_ep = (runtime.namespace().component(component)

@@ -19,6 +19,10 @@
 #   scripts/verify.sh obs         # engine flight recorder suite (stepstats
 #                                 # invariants, compile watchdog, /debug/
 #                                 # profile smoke, report golden)
+#   scripts/verify.sh disagg      # disaggregated KV handoff fault-model
+#                                 # suite: epoch guard, wire integrity,
+#                                 # chaos storms; echoes the repro seed
+#                                 # (DYNTPU_CHAOS_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -91,6 +95,23 @@ if [ "${1:-}" = "chaos" ]; then
         echo "chaos sweep FAILED; reproduce with e.g.:"
         for s in $seeds; do
             echo "  DYNTPU_${s} scripts/verify.sh chaos"
+        done
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "disagg" ]; then
+    set -o pipefail
+    rm -f /tmp/_disagg.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m disagg \
+        -p no:cacheprovider 2>&1 | tee /tmp/_disagg.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every disagg chaos test prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'CHAOS_SEED=[0-9]+' /tmp/_disagg.log | sort -u | tr '\n' ' ')
+        echo "disagg suite FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh disagg"
         done
     fi
     exit $rc

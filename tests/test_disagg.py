@@ -280,3 +280,160 @@ async def test_queue_no_consumer_times_out_to_local(queue_disagg_pair):
     assert len(got) == 4
     assert decode_handler.num_local_prefills == 1
     assert decode_handler.num_remote_prefills == 0
+
+
+# ----------------- epoch-guarded reservations (regression) ---------------
+# The stale-write race: decode reserves blocks, gives up (timeout/fallback),
+# the blocks are recycled to another request, and only THEN does the old
+# transfer arrive. Before the epoch guard this scattered foreign KV into
+# live blocks; now both the device-plane scatter and the relay inject
+# refuse the write.
+
+
+@pytest.mark.disagg
+async def test_stale_epoch_device_scatter_rejected():
+    """A delayed device-plane transfer aimed at a recycled reservation must
+    raise StaleEpochError inside the scatter and leave the new occupant's
+    blocks untouched (the round-robin/push-path half of the race)."""
+    from dynamo_tpu.disagg.ici import DevicePlane, StaleEpochError
+
+    src = make_engine(seed=0)
+    dst = make_engine(seed=1)
+    plane = DevicePlane()
+    try:
+        seq_p, _ = await src.prefill_held(Request(
+            request_id="p", token_ids=list(range(1, 17)), max_tokens=1,
+        ))
+
+        # first reservation: captured by the (slow, doomed) transfer
+        seq_a = dst.reserve_sequence(Request(
+            request_id="r", token_ids=list(range(1, 17)), max_tokens=4,
+        ))
+        assert seq_a is not None
+        old_epoch, old_blocks = seq_a.kv_epoch, list(seq_a.block_table)
+
+        # decode gives up; the very same request id re-reserves (retry) and
+        # the pool hands back overlapping blocks
+        dst.cancel_reservation(seq_a)
+        seq_b = dst.reserve_sequence(Request(
+            request_id="r", token_ids=list(range(1, 17)), max_tokens=4,
+        ))
+        assert seq_b is not None
+        assert seq_b.kv_epoch > old_epoch
+        baseline = await dst.extract_kv_blocks(seq_b.block_table)
+
+        with pytest.raises(StaleEpochError):
+            await plane.transfer(
+                src, seq_p.block_table[: len(old_blocks)], dst, old_blocks,
+                dst_seq_id="r", dst_epoch=old_epoch,
+            )
+        after = await dst.extract_kv_blocks(seq_b.block_table)
+        np.testing.assert_array_equal(
+            np.asarray(after["k"]), np.asarray(baseline["k"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(after["v"]), np.asarray(baseline["v"])
+        )
+
+        # the current epoch is accepted
+        n = min(len(seq_p.block_table), len(seq_b.block_table))
+        moved = await plane.transfer(
+            src, seq_p.block_table[:n], dst, list(seq_b.block_table)[:n],
+            dst_seq_id="r", dst_epoch=seq_b.kv_epoch,
+        )
+        assert moved > 0
+        dst.cancel_reservation(seq_b)
+        src.release_held(seq_p)
+    finally:
+        await src.stop()
+        await dst.stop()
+
+
+@pytest.mark.disagg
+async def test_stale_epoch_relay_inject_rejected():
+    """The relay half of the race: a queued prefill's push arrives after
+    the request id was re-reserved under a new epoch. The inject handler
+    answers a permanent reject (so the prefill side won't retry) and the
+    new reservation's bytes stay untouched."""
+    import time as _time
+
+    from dynamo_tpu.disagg.handlers import PendingHandoff
+    from dynamo_tpu.disagg.ici import DevicePlane
+
+    engine = make_engine(seed=0)
+    try:
+        dh = DecodeHandler(engine, prefill_client=None,
+                           config=DisaggConfig(), plane=DevicePlane())
+        seq_a = engine.reserve_sequence(Request(
+            request_id="r", token_ids=list(range(1, 17)), max_tokens=4,
+        ))
+        old_epoch = seq_a.kv_epoch
+        engine.cancel_reservation(seq_a)
+        seq_b = engine.reserve_sequence(Request(
+            request_id="r", token_ids=list(range(1, 17)), max_tokens=4,
+        ))
+        done = asyncio.get_running_loop().create_future()
+        dh.pending["r"] = PendingHandoff(
+            seq=seq_b, done=done, epoch=seq_b.kv_epoch,
+            deadline=_time.monotonic() + 30.0,
+        )
+        baseline = await engine.extract_kv_blocks(seq_b.block_table)
+        payload = kv_to_wire({
+            "k": np.asarray(baseline["k"]) + 1.0,
+            "v": np.asarray(baseline["v"]) - 1.0,
+        })
+        payload.update(request_id="r", epoch=old_epoch, first_token=7)
+
+        inj = dh.inject_handler()
+        acks = [a async for a in inj.generate(payload, Context())]
+        assert acks and acks[0]["ok"] is False
+        assert acks[0].get("permanent") is True
+        assert dh.num_epoch_rejects == 1
+        assert not done.done()  # decode keeps waiting for a valid push
+
+        after = await engine.extract_kv_blocks(seq_b.block_table)
+        np.testing.assert_array_equal(
+            np.asarray(after["k"]), np.asarray(baseline["k"])
+        )
+
+        # same frame with the live epoch is accepted and wakes decode
+        payload = kv_to_wire({
+            "k": np.asarray(baseline["k"]) + 1.0,
+            "v": np.asarray(baseline["v"]) - 1.0,
+        })
+        payload.update(request_id="r", epoch=seq_b.kv_epoch, first_token=7)
+        acks = [a async for a in inj.generate(payload, Context())]
+        assert acks and acks[0]["ok"] is True
+        assert done.done() and done.result() == 7
+        dh.pending.pop("r")
+        engine.cancel_reservation(seq_b)
+        dh.close()
+    finally:
+        await engine.stop()
+
+
+@pytest.mark.disagg
+async def test_resume_or_cancel_closes_epoch_window():
+    """reservation_valid flips false the moment the reservation is
+    consumed (resume) or abandoned (cancel) — the epoch's validity window
+    is exactly reserve → resume/cancel."""
+    engine = make_engine(seed=0)
+    try:
+        seq = engine.reserve_sequence(Request(
+            request_id="w", token_ids=list(range(1, 9)), max_tokens=1,
+        ))
+        assert engine.reservation_valid("w", seq.kv_epoch)
+        assert not engine.reservation_valid("w", seq.kv_epoch + 1)
+        outs = []
+        async for out in engine.resume_prefilled(seq, first_token=3):
+            outs.append(out)
+        assert not engine.reservation_valid("w", seq.kv_epoch)
+
+        seq2 = engine.reserve_sequence(Request(
+            request_id="w2", token_ids=list(range(1, 9)), max_tokens=1,
+        ))
+        assert engine.reservation_valid("w2", seq2.kv_epoch)
+        engine.cancel_reservation(seq2)
+        assert not engine.reservation_valid("w2", seq2.kv_epoch)
+    finally:
+        await engine.stop()

@@ -1,0 +1,156 @@
+"""Disagg chaos suite: seeded fault storms against a real P/D pair.
+
+Every scenario runs three byte-identical tiny engines (prefill, decode,
+and a serial local reference), injects seeded faults at the disagg.*
+sites, and asserts the handoff invariants the fault model promises:
+
+- **byte parity** — every completed request matches the local-prefill
+  reference token-for-token, whether it rode the remote path, a retry,
+  or the fallback cascade (greedy decoding makes all paths identical);
+- **zero KV corruption** — a poisoned-block canary planted in the decode
+  pool before the storm is bit-exact after it (no stale/truncated
+  transfer ever scattered into foreign blocks);
+- **zero leaks** — block pools return to their baselines and no pending
+  handoffs, held sequences, or reservations survive the sweep.
+
+Seeds come from DYNTPU_CHAOS_SEED (comma-separated) and each run prints
+``CHAOS_SEED=<n>`` so a failure reproduces with::
+
+    DYNTPU_CHAOS_SEED=<n> pytest tests/test_disagg_chaos.py -k <name>
+"""
+
+import os
+
+import pytest
+
+from dynamo_tpu.mocker.cluster import DisaggChaosScenario, run_disagg_scenario
+from dynamo_tpu.tracing.collector import get_tracer
+
+pytestmark = [pytest.mark.anyio, pytest.mark.disagg, pytest.mark.chaos]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _seeds():
+    env = os.environ.get("DYNTPU_CHAOS_SEED")
+    if env:
+        return [int(s) for s in env.split(",")]
+    return [0]
+
+
+def _assert_invariants(report: dict) -> None:
+    print(f"CHAOS_SEED={report['seed']}")
+    print(f"chaos report: {report}")
+    assert report["completed"] == report["num_requests"], report
+    assert report["parity_failures"] == 0, report
+    assert not report["canary_corrupted"], report
+    assert report["leaked_blocks"] == 0, report
+    assert report["leaked_pending"] == 0, report
+    assert report["leaked_reservations"] == 0, report
+
+
+def _disagg_spans() -> set:
+    return {s.name for s in get_tracer()._ring if s.name.startswith("disagg.")}
+
+
+@pytest.mark.parametrize("seed", _seeds())
+async def test_chaos_device_transfer_flaky(seed):
+    """Device-plane pushes drop twice then succeed: the retry budget
+    absorbs the flap without fallback, and parity/leak invariants hold."""
+
+    def plan(p):
+        p.drop_connection("disagg.transfer", times=2)
+
+    report = await run_disagg_scenario(DisaggChaosScenario(
+        name="device_transfer_flaky", seed=seed, num_requests=4,
+        plan_fn=plan,
+    ))
+    _assert_invariants(report)
+    assert report["faults_fired"] >= 2, report
+    assert report["transfer_retries"] >= 2, report
+    assert report["remote_prefills"] >= 1, report
+    spans = _disagg_spans()
+    assert {"disagg.prefill", "disagg.transfer",
+            "disagg.handoff"} <= spans, spans
+
+
+@pytest.mark.parametrize("seed", _seeds())
+async def test_chaos_relay_corruption(seed):
+    """Host-relay frames are truncated mid-flight: the integrity check
+    rejects them without raising out of the inject handler, the retry
+    resends clean bytes, and no corrupt block ever lands (canary)."""
+
+    def plan(p):
+        p.truncate_stream("disagg.transfer", times=2)
+
+    report = await run_disagg_scenario(DisaggChaosScenario(
+        name="relay_corruption", seed=seed, num_requests=4,
+        relay_only=True, plan_fn=plan,
+    ))
+    _assert_invariants(report)
+    assert report["faults_fired"] >= 1, report
+    assert report["integrity_rejects"] >= 1, report
+    assert report["transfer_retries"] >= 1, report
+    # the relay leg is the one that records an inject span on success
+    assert "disagg.inject" in _disagg_spans()
+
+
+@pytest.mark.parametrize("seed", _seeds())
+async def test_chaos_inject_endpoint_flap(seed):
+    """The kv_inject ingress drops requests: per-attempt timeouts fire,
+    retries re-push, and anything that exhausts the budget falls back to
+    local prefill — still byte-identical, still leak-free."""
+
+    def plan(p):
+        p.drop_connection("disagg.inject", times=3)
+
+    report = await run_disagg_scenario(DisaggChaosScenario(
+        name="inject_flap", seed=seed, num_requests=4,
+        relay_only=True, inject_timeout_s=0.5, plan_fn=plan,
+    ))
+    _assert_invariants(report)
+    assert report["faults_fired"] >= 1, report
+    assert report["remote_prefills"] + report["local_prefills"] >= 4, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_chaos_prefill_kill_and_queue_expiry(seed):
+    """Queue mode under a compound storm: slow remote prefills against a
+    tiny queue budget, plus a hard kill of the queue worker after its
+    first pull (it resurrects shortly after). Expired/orphaned handoffs
+    cascade to local prefill; reservations and blocks all come back."""
+
+    def plan(p):
+        p.delay("disagg.prefill", 0.4)
+
+    report = await run_disagg_scenario(DisaggChaosScenario(
+        name="prefill_kill", seed=seed, num_requests=5, use_queue=True,
+        queue_wait_s=1.0, handoff_timeout_s=3.0, inflight_grace_s=1.0,
+        plan_fn=plan, kill_prefill_after_pulls=1, revive_prefill=True,
+    ))
+    _assert_invariants(report)
+    # the kill or the expiry budget must have forced at least one request
+    # off the remote path
+    assert report["local_prefills"] >= 1, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_chaos_store_flap(seed):
+    """The store connection flaps while decode enqueues prefill work:
+    failed queue ops trip the fallback cascade and every request still
+    completes locally with byte parity and no leaked reservation."""
+
+    def plan(p):
+        p.drop_connection("store.call", match="q_", times=4)
+
+    report = await run_disagg_scenario(DisaggChaosScenario(
+        name="store_flap", seed=seed, num_requests=4, use_queue=True,
+        queue_wait_s=1.5, handoff_timeout_s=4.0, plan_fn=plan,
+    ))
+    _assert_invariants(report)
+    assert report["completed"] == 4, report

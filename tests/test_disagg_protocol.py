@@ -1,0 +1,164 @@
+"""KV wire-format integrity envelope + disagg gauge forward-compat.
+
+The relay is the only handoff path that crosses a real network, so its
+frames carry size + CRC32 envelopes and the decode side must reject any
+damaged frame *before* it can touch reserved blocks. These tests pin the
+reject taxonomy (truncation, bit-flip, dtype mangling, shape lies) and
+the older-peer downgrade (no CRC → size check only).
+"""
+
+import asyncio
+
+import msgpack
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.protocol import (
+    KvIntegrityError,
+    kv_from_wire,
+    kv_to_wire,
+)
+
+pytestmark = pytest.mark.disagg
+
+
+def _payload(dtype=np.float32, shape=(2, 3, 4)):
+    rng = np.random.default_rng(0)
+    return {
+        "k": rng.standard_normal(shape).astype(dtype),
+        "v": rng.standard_normal(shape).astype(dtype),
+    }
+
+
+def test_round_trip_bit_exact():
+    data = _payload()
+    out = kv_from_wire(kv_to_wire(data))
+    np.testing.assert_array_equal(out["k"], data["k"])
+    np.testing.assert_array_equal(out["v"], data["v"])
+    assert out["k"].dtype == data["k"].dtype
+
+
+def test_round_trip_survives_msgpack():
+    wire = kv_to_wire(_payload())
+    thawed = msgpack.unpackb(msgpack.packb(wire), raw=False)
+    out = kv_from_wire(thawed)
+    np.testing.assert_array_equal(out["v"], _payload()["v"])
+
+
+def test_round_trip_bfloat16():
+    import ml_dtypes
+
+    data = _payload(dtype=ml_dtypes.bfloat16)
+    out = kv_from_wire(kv_to_wire(data))
+    assert out["k"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        out["k"].view(np.uint16), data["k"].view(np.uint16)
+    )
+
+
+def test_truncated_payload_rejected():
+    wire = kv_to_wire(_payload())
+    wire["k"] = wire["k"][: len(wire["k"]) // 2]
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(wire)
+
+
+def test_bit_flip_rejected():
+    wire = kv_to_wire(_payload())
+    vb = bytearray(wire["v"])
+    vb[7] ^= 0x40
+    wire["v"] = bytes(vb)
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(wire)
+
+
+def test_dtype_mangled_rejected():
+    wire = kv_to_wire(_payload())
+    wire["dtype"] = "not-a-dtype"
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(wire)
+
+
+def test_shape_lie_rejected():
+    # a shape that implies a different byte count than the payload
+    wire = kv_to_wire(_payload(shape=(2, 3, 4)))
+    wire["shape"] = [2, 3, 5]
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(wire)
+
+
+def test_older_peer_without_crc_still_size_checked():
+    wire = kv_to_wire(_payload())
+    wire.pop("k_crc")
+    wire.pop("v_crc")
+    out = kv_from_wire(wire)  # valid frame decodes fine without CRC
+    assert out["k"].shape == (2, 3, 4)
+    wire["v"] = wire["v"][:-4]
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(wire)
+
+
+@pytest.mark.anyio
+async def test_aggregator_disagg_gauges_forward_compat():
+    """Snapshots WITHOUT a disagg section must still publish all four
+    disagg gauges at 0.0 (dashboards stay stable across mixed-version
+    fleets); snapshots with the section flow through labeled."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        agg = MetricsAggregator(runtime, "backend")
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        # older worker: no "disagg" section at all
+        await runtime.store.publish(subject + "1", msgpack.packb({
+            "worker_id": 1, "kv_usage": 0.1,
+        }))
+        # disagg-aware worker
+        await runtime.store.publish(subject + "2", msgpack.packb({
+            "worker_id": 2, "kv_usage": 0.2,
+            "disagg": {"fallback_total": 3.0, "breaker_open": 1.0,
+                       "transfer_retries_total": 5.0,
+                       "orphans_reaped_total": 2.0},
+        }))
+        for _ in range(100):
+            if "1" in agg.worker_stats and "2" in agg.worker_stats:
+                break
+            await asyncio.sleep(0.01)
+        from dynamo_tpu.utils.metrics import validate_exposition
+
+        samples = validate_exposition(runtime.metrics.render())
+
+        def val(name, worker):
+            for s in samples:
+                if s.name == name and s.labels.get("worker") == worker:
+                    return s.value
+            return None
+
+        for gauge in ("dynamo_disagg_fallback_total",
+                      "dynamo_disagg_breaker_open",
+                      "dynamo_disagg_transfer_retries_total",
+                      "dynamo_disagg_orphans_reaped_total"):
+            assert val(gauge, "1") == 0.0, gauge
+        assert val("dynamo_disagg_fallback_total", "2") == 3.0
+        assert val("dynamo_disagg_breaker_open", "2") == 1.0
+        assert val("dynamo_disagg_transfer_retries_total", "2") == 5.0
+        assert val("dynamo_disagg_orphans_reaped_total", "2") == 2.0
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

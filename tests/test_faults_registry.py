@@ -1,0 +1,85 @@
+"""Fault-site registry: every ``faults.active(...)`` call site in the
+package must use a site name documented in the table at the top of
+``runtime/faults.py`` — an undocumented hook is a chaos scenario nobody
+can discover, and a documented-but-unwired site is a false promise.
+"""
+
+import ast
+import re
+from pathlib import Path
+
+import pytest
+
+import dynamo_tpu
+from dynamo_tpu.runtime import faults
+
+pytestmark = pytest.mark.disagg
+
+PKG_ROOT = Path(dynamo_tpu.__file__).parent
+
+
+def _documented_sites() -> set:
+    return set(re.findall(r"``([a-z_]+\.[a-z_]+)``\s", faults.__doc__ or ""))
+
+
+def _call_sites() -> dict:
+    """{site name: [file:line, ...]} for every faults.active("...") call
+    with a literal first argument, plus an entry under "<dynamic>" for any
+    call whose site isn't a string literal."""
+    sites = {}
+    for path in PKG_ROOT.rglob("*.py"):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            named_active = (
+                isinstance(fn, ast.Attribute) and fn.attr == "active"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "faults"
+            )
+            if not named_active:
+                continue
+            where = f"{path.relative_to(PKG_ROOT)}:{node.lineno}"
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                sites.setdefault(node.args[0].value, []).append(where)
+            else:
+                sites.setdefault("<dynamic>", []).append(where)
+    return sites
+
+
+def test_every_fault_call_site_is_documented():
+    documented = _documented_sites()
+    assert documented, "faults.py docstring table not parseable"
+    wired = _call_sites()
+    assert "<dynamic>" not in wired, (
+        f"faults.active() called with a non-literal site name at "
+        f"{wired.get('<dynamic>')} — literal names keep the registry "
+        f"greppable and this test meaningful"
+    )
+    undocumented = {
+        s: locs for s, locs in wired.items() if s not in documented
+    }
+    assert not undocumented, (
+        f"fault sites wired in code but missing from the faults.py "
+        f"docstring table: {undocumented}"
+    )
+
+
+def test_disagg_sites_are_wired():
+    wired = _call_sites()
+    for site in ("disagg.prefill", "disagg.transfer", "disagg.inject"):
+        assert site in wired, f"{site} documented but not wired anywhere"
+
+
+def test_documented_sites_exist_in_code():
+    """The reverse direction: the docstring must not promise sites that
+    no code consults. (``faults.active`` literal calls are the wiring for
+    all current sites.)"""
+    wired = set(_call_sites())
+    stale = _documented_sites() - wired
+    assert not stale, (
+        f"faults.py documents sites with no faults.active call site: "
+        f"{stale}"
+    )
