@@ -95,11 +95,13 @@ def _pct(values, q):
 # ------------------------------ child side --------------------------------
 
 
-def _kernel_check_class(B: int, T: int, spec_k: int = 4) -> dict:
+def _kernel_check_class(B: int, T: int, spec_k: int = 4,
+                        tile=(0, 0)) -> dict:
     """Ragged Pallas paged-attention vs the gathered-einsum path on one
     shape class: numerical max-abs-err + timed speedup on the real
     backend. All rows attend a full 512-token context of which the chunk
-    is the last T tokens."""
+    is the last T tokens. ``tile`` is the autotuned (q_tile, kv_tile) for
+    the class — (0, 0) times the kernel defaults."""
     import functools
 
     import jax
@@ -123,9 +125,15 @@ def _kernel_check_class(B: int, T: int, spec_k: int = 4) -> dict:
     seq_lens = jnp.full((B,), W * bs, jnp.int32)
 
     interpret = jax.default_backend() != "tpu"
+    q_tile, kv_tile = int(tile[0]), int(tile[1])
+    if q_tile > 0 and T % q_tile:
+        q_tile = 0  # tuned for a different chunk length — use the default
+    if kv_tile > 0 and bs % kv_tile:
+        kv_tile = 0
     if T == 1:
         decode = jax.jit(functools.partial(
-            paged_attention_decode, block_size=bs, interpret=interpret
+            paged_attention_decode, block_size=bs, kv_tile=kv_tile,
+            interpret=interpret,
         ))
 
         def kernel(q, kc, vc, tables, lens):
@@ -135,6 +143,7 @@ def _kernel_check_class(B: int, T: int, spec_k: int = 4) -> dict:
         q_lens = jnp.full((B,), T, jnp.int32)
         ragged = jax.jit(functools.partial(
             paged_attention_ragged, block_size=bs, max_q_len=T,
+            q_tile=q_tile, kv_tile=kv_tile,
             interpret=interpret,
         ))
 
@@ -181,19 +190,23 @@ def _kernel_check_class(B: int, T: int, spec_k: int = 4) -> dict:
     }
 
 
-def _kernel_check(spec_k: int = 4) -> dict:
+def _kernel_check(spec_k: int = 4, tiles=None) -> dict:
     """Probe the ragged kernel on the three serving shape classes (decode
     rows, spec [B, k+1] verify windows, prefill chunks); flat keys ride the
     bench JSON. ``kernel_speedup`` / ``kernel_ms`` keep their historical
-    decode-class meaning; ``kernel_max_abs_err`` is the worst class."""
+    decode-class meaning; ``kernel_max_abs_err`` is the worst class.
+    ``tiles`` maps class -> autotuned (q_tile, kv_tile) so the reported
+    speedups time the configuration that actually serves."""
     classes = {
         "decode": (32, 1),
         "spec": (32, spec_k + 1),
         "prefill": (4, 256),
     }
+    tiles = tiles or {}
     out: dict = {"kernel_max_abs_err": 0.0}
     for name, (B, T) in classes.items():
-        info = _kernel_check_class(B, T, spec_k)
+        info = _kernel_check_class(B, T, spec_k,
+                                   tile=tiles.get(name, (0, 0)))
         out[f"kernel_speedup_{name}"] = info["speedup"]
         out[f"kernel_ms_{name}"] = info["kernel_ms"]
         out[f"einsum_ms_{name}"] = info["einsum_ms"]
@@ -503,12 +516,43 @@ async def run_bench() -> dict:
             4),
     }
     if getattr(engine, "attention_impl_choice", None) is not None:
-        result["attention_impl_choice"] = engine.attention_impl_choice
+        choice = engine.attention_impl_choice
+        result["attention_impl_choice"] = choice
+        # the tuned kernel tiles that actually served this run ([0, 0] =
+        # kernel defaults) and whether they came from the persisted
+        # autotune cache (DYNTPU_AUTOTUNE_CACHE) or a fresh sweep
+        tiles = choice.get("tiles") or {}
+        for cls in ("decode", "spec", "prefill"):
+            result[f"attention_tile_config_{cls}"] = tiles.get(cls, [0, 0])
+        result["autotune_cache_hit"] = bool(
+            choice.get("autotune_cache_hit", False))
+    # adaptive bucket ladder state (flat static grid when the ladder is
+    # off: rungs_n == len(configured buckets), splits/retires == 0)
+    for kind in ("decode", "prefill"):
+        n_rungs = obs.get(f"ladder_{kind}_rungs_n")
+        if n_rungs is None:
+            continue
+        result[f"ladder_{kind}_rungs_n"] = int(n_rungs)
+        result[f"ladder_{kind}_splits"] = int(
+            obs.get(f"ladder_{kind}_splits_total", 0))
+        result[f"ladder_{kind}_retires"] = int(
+            obs.get(f"ladder_{kind}_retires_total", 0))
+        result[f"ladder_{kind}_budget_remaining"] = int(
+            obs.get(f"ladder_{kind}_budget_remaining", 0))
     if on_tpu:
         try:
-            result.update(_kernel_check(spec_k))
+            tuned = (getattr(engine, "attention_impl_choice", None)
+                     or {}).get("tiles") or {}
+            result.update(_kernel_check(spec_k, tiles=tuned))
         except Exception as e:  # the headline number still stands
             result["kernel_error"] = f"{type(e).__name__}: {e}"
+        result["notes"] = (
+            "next-run on-TPU targets for the autotune+ladder campaign: "
+            "MFU >= 0.15, >= 3x tok/s/chip over the 455 r05 baseline, "
+            "kernel_speedup_decode/spec/prefill >= 1.3 with swept "
+            "attention_tile_config_* (run with DYNTPU_AUTOTUNE_CACHE set "
+            "to persist winners; DYNTPU_LADDER_ENABLED=1 for adaptive "
+            "buckets)")
     faulthandler.cancel_dump_traceback_later()
     return result
 

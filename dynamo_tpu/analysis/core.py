@@ -97,6 +97,11 @@ class JitInfo:
     static_names: Set[str] = field(default_factory=set)
     static_nums: Set[int] = field(default_factory=set)
     n_bound: int = 0  # leading params pre-bound by functools.partial (consts)
+    # donated buffers (jit-callable indices — add n_bound to map to params)
+    donate_names: Set[str] = field(default_factory=set)
+    donate_nums: Set[int] = field(default_factory=set)
+    # node findings anchor to: the jit call site, or the def for decorators
+    site: Optional[ast.AST] = None
 
 
 class ModuleContext:
@@ -215,6 +220,14 @@ class ModuleContext:
                 for c in ast.walk(kw.value):
                     if isinstance(c, ast.Constant) and isinstance(c.value, int):
                         info.static_nums.add(c.value)
+            elif kw.arg == "donate_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        info.donate_names.add(c.value)
+            elif kw.arg == "donate_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                        info.donate_nums.add(c.value)
         return info
 
     def _is_jit_name(self, node: ast.AST) -> bool:
@@ -233,19 +246,22 @@ class ModuleContext:
             if isinstance(node, _FUNC_NODES):
                 for dec in node.decorator_list:
                     if self._is_jit_name(dec):
-                        self.jit_targets[node] = JitInfo()
+                        self.jit_targets[node] = JitInfo(site=node)
                     elif isinstance(dec, ast.Call):
                         if self._is_jit_name(dec.func):
                             self.jit_targets[node] = self._jit_statics(dec)
+                            self.jit_targets[node].site = node
                         elif (self.dotted(dec.func) == "functools.partial"
                               and dec.args
                               and self._is_jit_name(dec.args[0])):
                             self.jit_targets[node] = self._jit_statics(dec)
+                            self.jit_targets[node].site = node
             # jax.jit(f, ...) / jax.jit(functools.partial(f, cfg), ...)
             elif isinstance(node, ast.Call) and self._is_jit_name(node.func):
                 if not node.args:
                     continue
                 info = self._jit_statics(node)
+                info.site = node
                 target = node.args[0]
                 if (isinstance(target, ast.Call)
                         and self.dotted(target.func) == "functools.partial"
@@ -255,8 +271,30 @@ class ModuleContext:
                     # tracers — branching on them never retraces
                     info.n_bound = len(target.args) - 1
                     target = target.args[0]
+                elif (isinstance(target, ast.Call)
+                      and isinstance(target.func, ast.Name)
+                      and target.func.id in defs):
+                    # jax.jit(raw_X(...)): the factory-call idiom — the
+                    # jitted callable is the inner def the factory returns,
+                    # and donate/static indices address ITS signature
+                    inner = self._factory_inner(defs[target.func.id])
+                    if inner is not None:
+                        self.jit_targets.setdefault(inner, info)
+                    continue
                 if isinstance(target, ast.Name) and target.id in defs:
                     self.jit_targets.setdefault(defs[target.id], info)
+
+    def _factory_inner(self, factory: ast.AST) -> Optional[ast.AST]:
+        """The inner def a factory returns (``def make(): def f(..) ...;
+        return f``), or None when the return is anything more clever."""
+        inner = {n.name: n for n in factory.body
+                 if isinstance(n, _FUNC_NODES)}
+        for stmt in factory.body:
+            if (isinstance(stmt, ast.Return)
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id in inner):
+                return inner[stmt.value.id]
+        return None
 
     def traced_params(self, func: ast.AST) -> Set[str]:
         """Parameter names of a jit target that are traced (non-static)."""

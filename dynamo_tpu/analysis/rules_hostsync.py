@@ -88,4 +88,40 @@ class HostTransferSync(Rule):
                     "in a hot path")
 
 
-RULES = [HostScalarSync(), HostTransferSync()]
+class NonDonatedDeviceBuffer(Rule):
+    code = "DT103"
+    name = "non-donated-device-buffer"
+    rationale = ("a jitted hot-path fn taking a persistent device buffer "
+                 "(cache/ctl/last_tok/ring) without donating it doubles the "
+                 "buffer's HBM and inserts a copy every step; donate or "
+                 "waive with a stated reason")
+
+    # the engine's persistent mutable device state, by parameter name.
+    # Deliberately exact matches: the paged attention ops take the same
+    # cache as read-only `k_cache`/`v_cache` views — donation there is
+    # owned one level up by the step fn that threads the cache through.
+    BUFFER_PARAMS = ("cache", "ctl", "last_tok", "ring")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for func, info in ctx.jit_targets.items():
+            if not ctx.hot_scope(func):
+                continue
+            args = func.args
+            names = [a.arg for a in args.posonlyargs + args.args]
+            for p, name in enumerate(names):
+                if name not in self.BUFFER_PARAMS:
+                    continue
+                if (p < info.n_bound or p in info.static_nums
+                        or name in info.static_names):
+                    continue  # a Python const, not a device buffer
+                if ((p - info.n_bound) in info.donate_nums
+                        or name in info.donate_names):
+                    continue
+                yield ctx.finding(
+                    self.code, info.site or func,
+                    f"jitted `{ctx.qualname(func)}` takes device buffer "
+                    f"`{name}` without donating it; add donate_argnums or "
+                    "waive with a reason (# dynalint: disable=DT103)")
+
+
+RULES = [HostScalarSync(), HostTransferSync(), NonDonatedDeviceBuffer()]

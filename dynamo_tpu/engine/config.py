@@ -122,6 +122,22 @@ class EngineConfig:
     attention_impl_decode: str = ""
     attention_impl_spec: str = ""
     attention_impl_prefill: str = ""
+    # per-shape-class (q_tile, kv_tile) for the ragged pallas kernel.
+    # (0, 0) = kernel defaults; engine/autotune.py's tile sweep fills these
+    # with the fastest byte-parity-verified candidate per class (persisted
+    # across runs via DYNTPU_AUTOTUNE_CACHE). q_tile must divide the class's
+    # query window (decode: 1); kv_tile must divide block_size.
+    attention_tile_decode: Tuple[int, int] = (0, 0)
+    attention_tile_spec: Tuple[int, int] = (0, 0)
+    attention_tile_prefill: Tuple[int, int] = (0, 0)
+    # adaptive bucket ladders (engine/ladder.py): let the engine split hot
+    # decode/prefill buckets and retire cold ones from the flight recorder's
+    # live per-bucket occupancy, under ladder_compile_budget extra rungs per
+    # ladder. Off by default — static buckets stay fully deterministic.
+    adaptive_buckets: bool = False
+    # max rungs each ladder may ADD over its lifetime; bounds steady-state
+    # recompiles (one program per new rung, watchdog-attributed)
+    ladder_compile_budget: int = 4
     # chunked prefill: cap each prefill chunk at this many tokens so long
     # prompts are admitted in slices interleaved with running decodes under
     # max_num_batched_tokens, instead of one whole-prompt stall that blows
@@ -192,6 +208,21 @@ class EngineConfig:
                 raise ValueError(
                     f"unknown attention_impl_{cls} {v!r}"
                 )
+        for cls in ("decode", "spec", "prefill"):
+            tile = getattr(self, f"attention_tile_{cls}")
+            if (len(tile) != 2 or tile[0] < 0 or tile[1] < 0):
+                raise ValueError(
+                    f"attention_tile_{cls} must be (q_tile>=0, kv_tile>=0)"
+                )
+            if tile[1] > 0 and self.block_size % tile[1]:
+                raise ValueError(
+                    f"attention_tile_{cls} kv_tile {tile[1]} must divide "
+                    f"block_size {self.block_size}"
+                )
+        if self.attention_tile_decode[0] > 1:
+            raise ValueError("decode q_tile must be 0 or 1 (one query/row)")
+        if self.ladder_compile_budget < 0:
+            raise ValueError("ladder_compile_budget must be >= 0")
         if self.prefill_chunk_tokens < 0:
             raise ValueError("prefill_chunk_tokens must be >= 0")
         if self.spec_mode != "off":

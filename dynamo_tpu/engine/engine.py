@@ -597,6 +597,17 @@ class EngineCore(AsyncEngine):
         from ..observability import compilewatch
         snap = self.obs.snapshot()
         snap.update(compilewatch.snapshot())
+        # adaptive bucket ladders (InferenceEngine only): scalar gauges by
+        # the exact keys observability.gauges reads; the rungs tuple is
+        # non-scalar and stays off the wire dict
+        for kind, lad in getattr(self, "_ladders", {}).items():
+            ls = lad.snapshot()
+            snap[f"ladder_{kind}_rungs"] = ls["rungs"]
+            snap[f"ladder_{kind}_rungs_n"] = len(ls["rungs"])
+            snap[f"ladder_{kind}_splits_total"] = ls["splits_total"]
+            snap[f"ladder_{kind}_retires_total"] = ls["retires_total"]
+            snap[f"ladder_{kind}_budget_remaining"] = ls["budget_remaining"]
+            snap[f"ladder_{kind}_converged"] = int(ls["converged"])
         return snap
 
     def mark_obs_warmup_done(self) -> None:
@@ -887,14 +898,19 @@ class InferenceEngine(EngineCore):
         seed: int = 0,
         devices: Optional[list] = None,
     ):
-        # attention_impl="auto": time Pallas vs einsum on the live backend
-        # and bake the winner into the config BEFORE any step fn is built
-        self.attention_impl_choice: Optional[dict] = None
-        if engine_config.attention_impl == "auto":
-            from .autotune import probe_attention_impl
-            engine_config, self.attention_impl_choice = (
-                probe_attention_impl(model_config, engine_config)
-            )
+        # attention autotune, BEFORE any step fn is built: the impl probe
+        # (attention_impl="auto" times Pallas vs einsum on the live
+        # backend) plus per-shape-class (q_tile, kv_tile) resolution —
+        # explicit config > persisted cache (DYNTPU_AUTOTUNE_CACHE) >
+        # on-TPU sweep > kernel defaults
+        from .autotune import autotune_attention
+        engine_config, self.attention_impl_choice = autotune_attention(
+            model_config, engine_config
+        )
+        # adaptive bucket ladders (engine/ladder.py); built after the
+        # recorder below when enabled, {} keeps every bucketing call on
+        # the static grid
+        self._ladders: Dict[str, Any] = {}
         if engine_config.prefill_chunk_tokens > 0:
             pct = max(engine_config.prefill_chunk_tokens,
                       engine_config.block_size)
@@ -1047,6 +1063,36 @@ class InferenceEngine(EngineCore):
                 jsonl_path=env_str("DYNTPU_OBS_STEPSTATS_PATH", ""),
             )
             compilewatch.install()
+        # waste-driven adaptive bucket ladders: consume the recorder's
+        # per-bucket occupancy, split hot rungs / retire cold ones under
+        # an explicit compile budget. Needs the recorder (occupancy
+        # source) and the single-engine path (pp keeps static buckets).
+        if (self.obs is not None and self.pp == 1
+                and (engine_config.adaptive_buckets
+                     or env_flag("DYNTPU_LADDER_ENABLED", False))):
+            from .ladder import BucketLadder
+            budget = engine_config.ladder_compile_budget
+            self._ladders = {
+                # decode windows and spec verify windows share the row
+                # bucket grid (and its compiled programs)
+                "decode": BucketLadder(
+                    "decode", engine_config.decode_buckets,
+                    kinds=(DECODE, SPEC_VERIFY),
+                    compile_budget=budget, step=8,
+                ),
+                "prefill": BucketLadder(
+                    "prefill", engine_config.prefill_buckets,
+                    kinds=(PREFILL,),
+                    compile_budget=budget, step=16,
+                ),
+            }
+            # the scheduler snaps chunked-prefill caps onto live rungs
+            self.scheduler.prefill_ladder = self._ladders["prefill"]
+            log.info(
+                "adaptive bucket ladders on: budget=%d rungs decode=%r "
+                "prefill=%r", budget, engine_config.decode_buckets,
+                engine_config.prefill_buckets,
+            )
         self._rng = jax.random.PRNGKey(seed + 1)
         self._encode_fn = None  # built lazily on the first embed()
         self._mm_ring_fn = None  # lazy (pipelined mm prefill)
@@ -1359,6 +1405,18 @@ class InferenceEngine(EngineCore):
                 rec.goodput_tokens = emitted
             self.obs.commit(rec)
         recs.clear()
+        if self._ladders:
+            self._ladder_tick()
+
+    @hot_path
+    def _ladder_tick(self) -> None:
+        """Feed the recorder's occupancy histogram to the bucket ladders
+        and run one (cheap, host-int) adaptation check. Called on every
+        landing; BucketLadder.min_dispatches gates actual epochs."""
+        occ = self.obs.bucket_occupancy()
+        for lad in self._ladders.values():
+            lad.ingest(occ)
+            lad.maybe_adapt()
 
     @hot_path
     def _unpack_spec(self, batch, out, col_of) -> List[List[int]]:
@@ -1411,11 +1469,23 @@ class InferenceEngine(EngineCore):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _bucket_for(self, kind: str, n: int) -> int:
+        """Bucket ``n`` on the live ladder grid for ``kind`` (adaptive
+        rungs when the ladder is on, the static config grid otherwise)."""
+        lad = self._ladders.get(kind)
+        if lad is not None:
+            return lad.bucket_for(n)
+        cfg = self.config
+        return _bucket(
+            n, cfg.decode_buckets if kind == "decode"
+            else cfg.prefill_buckets,
+        )
+
     def _prefill_arrays(self, chunk: PrefillChunk, use_sp: bool):
         cfg = self.config
         seq = chunk.seq
         if chunk.length <= max(cfg.prefill_buckets) and not use_sp:
-            T = _bucket(chunk.length, cfg.prefill_buckets)
+            T = self._bucket_for("prefill", chunk.length)
         else:
             # sp full-prompt chunks (and any oversized chunk) bucket to the
             # next power of two — always divisible by the sp ring size
@@ -1480,6 +1550,7 @@ class InferenceEngine(EngineCore):
             L, S = chunk.length, chunk.start
             obs_out.append(StepRecord(
                 kind=PREFILL, t_dispatch=time.monotonic(),
+                bucket=a["tokens"].shape[1],
                 rows=1, live_rows=1,
                 padded_tokens=a["tokens"].shape[1], real_tokens=L,
                 goodput_tokens=L,
@@ -1649,7 +1720,7 @@ class InferenceEngine(EngineCore):
         # token K steps behind the host mirror's back. Rebuild + upload
         # excludes it; its device state is untouched until re-scheduled.
         needed = [r.slot for r in rows]
-        B = _bucket(len(needed), cfg.decode_buckets)
+        B = self._bucket_for("decode", len(needed))
         live = {s for s in self._ap_cols if s in self._ap}
         if (self._ap_rows_dev is None or len(self._ap_cols) != B
                 or live != set(needed)):
@@ -1671,6 +1742,7 @@ class InferenceEngine(EngineCore):
             obs_out.append(StepRecord(
                 kind=SPEC_VERIFY if spec else DECODE,
                 t_dispatch=time.monotonic(),
+                bucket=B,
                 rows=B, live_rows=len(rows),
                 padded_tokens=B * K, real_tokens=len(rows) * K,
                 context_sum=ctx,
@@ -1742,7 +1814,7 @@ class InferenceEngine(EngineCore):
     def _run_decode(self, batch) -> List[List[int]]:
         cfg = self.config
         rows = batch.decode_rows
-        B = _bucket(len(rows), cfg.decode_buckets)
+        B = self._bucket_for("decode", len(rows))
         W = _pow2_bucket(
             max(len(r.seq.block_table) for r in rows),
             cfg.max_blocks_per_seq,

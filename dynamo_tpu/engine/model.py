@@ -266,6 +266,21 @@ def resolve_attention_impl(eng: EngineConfig, attn_class: str) -> str:
     return "einsum"
 
 
+def _class_tile(eng: EngineConfig, attn_class: str, T: int) -> Tuple[int, int]:
+    """Effective ``(q_tile, kv_tile)`` for a shape class at window length T.
+
+    Tuned tiles are advisory: a q_tile that doesn't divide this trace's T
+    (a winner picked at the largest prefill bucket vs. a smaller chunk)
+    falls back to the kernel default (0) instead of failing the trace.
+    """
+    q_tile, kv_tile = getattr(eng, f"attention_tile_{attn_class}", (0, 0))
+    if q_tile > 0 and T % q_tile:
+        q_tile = 0
+    if kv_tile > 0 and eng.block_size % kv_tile:
+        kv_tile = 0
+    return q_tile, kv_tile
+
+
 def _paged_decode_attention(
     eng: EngineConfig,
     mesh: Optional[Mesh],
@@ -288,6 +303,7 @@ def _paged_decode_attention(
     kernel = functools.partial(
         paged_attention_decode,
         block_size=eng.block_size,
+        kv_tile=_class_tile(eng, "decode", 1)[1],
         interpret=interpret,
     )
     q3 = q[:, 0]  # [B, H, hd]
@@ -328,10 +344,13 @@ def _paged_ragged_attention(
 
     B, T, H, hd = q.shape
     interpret = jax.default_backend() != "tpu"
+    q_tile, kv_tile = _class_tile(eng, attention_class(eng, T), T)
     kernel = functools.partial(
         paged_attention_ragged,
         block_size=eng.block_size,
         max_q_len=T,
+        q_tile=q_tile,
+        kv_tile=kv_tile,
         interpret=interpret,
     )
     q_flat = q.reshape(B * T, H, hd)
@@ -1390,7 +1409,9 @@ def make_kv_ops(eng: EngineConfig):
         }
 
     return (
-        compilewatch.label(jax.jit(extract), "kv_extract"),
+        # read-only gather: the serving engine keeps using the cache after
+        # an extract, so donating it here would free live KV
+        compilewatch.label(jax.jit(extract), "kv_extract"),  # dynalint: disable=DT103
         compilewatch.label(
             jax.jit(inject, donate_argnums=(0,)), "kv_inject"
         ),

@@ -351,6 +351,11 @@ class Scheduler:
         # window may land anywhere from 1 to spec_k+1 of them; the engine
         # clears it again on adaptive auto-disable
         self.spec_plan_window: Optional[int] = None
+        # adaptive prefill bucket ladder (engine/ladder.py) when the
+        # engine enables it: chunk caps snap DOWN to a live rung so a
+        # chunked-prefill cap retired from the grid doesn't keep padding
+        # chunks up to a stale bucket
+        self.prefill_ladder = None
 
     # -- admission --
 
@@ -490,6 +495,13 @@ class Scheduler:
                     # boundaries can't strand a partial block's worth of
                     # budget forever.
                     eff_cap = min(max_bucket, max(pct, bs))
+                    if self.prefill_ladder is not None:
+                        # snap to the largest live rung ≤ cap: every chunk
+                        # pads up to a compiled bucket, so an off-grid cap
+                        # burns (bucket - cap) tokens per dispatch
+                        rung = self.prefill_ladder.rung_at_most(eff_cap)
+                        if rung is not None and rung >= bs:
+                            eff_cap = rung
                 chunk = min(budget, remaining, eff_cap)
                 if (chunk < remaining and chunk < eff_cap
                         and batch.prefills):

@@ -47,6 +47,7 @@ class StepRecord:
     kind: str                 # prefill | decode | spec_verify
     t_dispatch: float         # monotonic, at enqueue on the step thread
     t_land: float = 0.0       # monotonic, when the window's fetch landed
+    bucket: int = 0           # compiled bucket this dispatch padded up to
     rows: int = 0             # padded batch rows the program computes
     live_rows: int = 0        # rows carrying a scheduled sequence
     padded_tokens: int = 0    # tokens the compiled program computes
@@ -130,6 +131,12 @@ class StepStats:
         # lifetime totals (never pruned) — survive window rollover
         self.total_steps = 0
         self.total_goodput_tokens = 0
+        # per-(kind, bucket) occupancy, cumulative since warmup:
+        # "kind:bucket" -> [dispatches, real_units, padded_units] in bucket
+        # units (rows for decode/spec windows, tokens for prefill chunks).
+        # The adaptive bucket ladder (engine/ladder.py) consumes this via
+        # bucket_occupancy() and takes its own deltas.
+        self._bucket_occ: Dict[str, list] = {}
         # snapshot cache: span recording reads this per request; recomputing
         # the window sums each time would scale with request rate
         self._snap_cache: Optional[Dict[str, float]] = None
@@ -158,6 +165,13 @@ class StepStats:
             self._win.add(rec)
             self.total_steps += 1
             self.total_goodput_tokens += rec.goodput_tokens
+            if rec.bucket > 0:
+                occ = self._bucket_occ.setdefault(
+                    f"{rec.kind}:{rec.bucket}", [0, 0, 0])
+                occ[0] += 1
+                occ[1] += (rec.real_tokens if rec.kind == PREFILL
+                           else rec.live_rows)
+                occ[2] += rec.bucket
             self._snap_cache = None
             self._prune_locked(self._clock())
         if self.jsonl_path:
@@ -197,7 +211,18 @@ class StepStats:
             self._warmup_done = True
             self.total_steps = 0
             self.total_goodput_tokens = 0
+            self._bucket_occ.clear()
             self._snap_cache = None
+
+    def bucket_occupancy(self) -> Dict[str, tuple]:
+        """Cumulative per-(kind, bucket) occupancy since warmup.
+
+        ``"kind:bucket" -> (dispatches, real_units, padded_units)`` with
+        units native to the bucket axis (rows for decode/spec, tokens for
+        prefill).  Monotonic between warmup resets, so consumers (the
+        bucket ladder) can delta it safely."""
+        with self._lock:
+            return {k: tuple(v) for k, v in self._bucket_occ.items()}
 
     # ---------------------------- snapshot -----------------------------
 

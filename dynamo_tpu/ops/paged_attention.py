@@ -60,15 +60,15 @@ def _ragged_kernel(
     tables_ref,    # [R, W] int32 physical block ids (0 = trash)
     # blocks
     q_ref,         # [KV, TQ, G, hd]
-    k_ref,         # [1, KV, bs, hd]
-    v_ref,         # [1, KV, bs, hd]
+    k_ref,         # [1, KV, kv_tile, hd]
+    v_ref,         # [1, KV, kv_tile, hd]
     o_ref,         # [KV, TQ, G, hd]
     # scratch
     m_ref,         # [KV, TQ*G, 1] f32 running max
     l_ref,         # [KV, TQ*G, 1] f32 running denominator
     acc_ref,       # [KV, TQ*G, hd] f32 running numerator
     *,
-    block_size: int,
+    kv_tile: int,
     q_tile: int,
     scale: float,
 ):
@@ -76,7 +76,11 @@ def _ragged_kernel(
     t = pl.program_id(1)
     w = pl.program_id(2)
     num_w = pl.num_programs(2)
-    bs = block_size
+    # grid step w covers absolute key positions [w*kv_tile, (w+1)*kv_tile):
+    # when kv_tile sub-splits a physical block, consecutive w walk its
+    # sub-tiles in order, so the online-softmax math below is oblivious to
+    # whether a step is a whole block or a slice of one.
+    bs = kv_tile
 
     q_len = q_len_ref[r]
     ctx_len = ctx_len_ref[r]
@@ -155,7 +159,8 @@ def _ragged_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_size", "q_tile", "max_q_len", "interpret"),
+    static_argnames=("block_size", "q_tile", "kv_tile", "max_q_len",
+                     "interpret"),
 )
 def paged_attention_ragged(
     q: jax.Array,             # [Tq, H, hd] flat packed queries
@@ -169,6 +174,7 @@ def paged_attention_ragged(
     block_size: int,
     max_q_len: int,
     q_tile: int = 0,
+    kv_tile: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     """Ragged paged attention over heterogeneous-length query rows.
@@ -182,6 +188,16 @@ def paged_attention_ragged(
     ``[Tq, H, hd]``; slots past ``q_len[r]`` but inside an allotted tile
     that holds at least one valid query — and every slot of a dead row —
     come back as exact zeros.
+
+    ``(q_tile, kv_tile)`` are pure performance knobs (``engine.autotune``
+    sweeps them per shape class): ``q_tile`` sets the output tile height,
+    ``kv_tile`` the per-grid-step key window.  ``kv_tile`` must divide
+    ``block_size``; values below it sub-split each physical block into
+    ``block_size // kv_tile`` grid steps that DMA consecutive slices of the
+    same block (paged tables are non-contiguous, so a step can never span
+    *more* than one block — tuning upward means growing ``block_size``
+    itself, a cache-layout change the autotuner only ever recommends).
+    ``0`` means the default (``min(max_q_len, 128)`` / ``block_size``).
     """
     Tq, H, hd = q.shape
     KV = k_cache.shape[1]
@@ -195,6 +211,13 @@ def paged_attention_ragged(
         raise ValueError(
             f"q_tile {q_tile} must divide max_q_len {max_q_len} and Tq {Tq}"
         )
+    if kv_tile <= 0:
+        kv_tile = bs
+    if bs % kv_tile:
+        raise ValueError(
+            f"kv_tile {kv_tile} must divide block_size {bs}"
+        )
+    splits = bs // kv_tile
     num_t = max_q_len // q_tile
 
     # head-packed flat layout: [KV, Tq, G, hd] so a q tile is one
@@ -207,20 +230,21 @@ def paged_attention_ragged(
 
     def kv_map(r, t, w, q_start, q_len, ctx_len, tables):
         # steps that do no work (dead tile, block past the tile's causal
-        # frontier) DMA the always-resident trash block instead of real KV
+        # frontier) DMA the always-resident trash block instead of real KV.
+        # w walks sub-tiles: physical block w // splits, slice w % splits.
         alloc, t_eff = _row_tile(t, q_start, r, q_tile)
         live = (t < alloc) & (t_eff * q_tile < q_len[r])
         last_q = jnp.minimum((t_eff + 1) * q_tile, q_len[r]) - 1
-        use = live & (w * bs <= ctx_len[r] - q_len[r] + last_q)
-        return (jnp.where(use, tables[r, w], 0), 0, 0, 0)
+        use = live & (w * kv_tile <= ctx_len[r] - q_len[r] + last_q)
+        return (jnp.where(use, tables[r, w // splits], 0), 0, w % splits, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
-        grid=(R, num_t, W),
+        grid=(R, num_t, W * splits),
         in_specs=[
             pl.BlockSpec((KV, q_tile, G, hd), q_map),
-            pl.BlockSpec((1, KV, bs, hd), kv_map),
-            pl.BlockSpec((1, KV, bs, hd), kv_map),
+            pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
+            pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
         ],
         out_specs=pl.BlockSpec((KV, q_tile, G, hd), q_map),
         scratch_shapes=[
@@ -231,7 +255,7 @@ def paged_attention_ragged(
     )
 
     kernel = functools.partial(
-        _ragged_kernel, block_size=bs, q_tile=q_tile,
+        _ragged_kernel, kv_tile=kv_tile, q_tile=q_tile,
         scale=1.0 / (hd ** 0.5),
     )
     out = pl.pallas_call(
@@ -244,7 +268,7 @@ def paged_attention_ragged(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "interpret")
+    jax.jit, static_argnames=("block_size", "kv_tile", "interpret")
 )
 def paged_attention_decode(
     q: jax.Array,          # [B, H, hd]
@@ -254,6 +278,7 @@ def paged_attention_decode(
     seq_lens: jax.Array,      # [B] int32 (0 = padding row)
     *,
     block_size: int,
+    kv_tile: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     """Single-token-per-sequence paged attention.  Returns ``[B, H, hd]``.
@@ -268,5 +293,6 @@ def paged_attention_decode(
     q_len = (seq_lens > 0).astype(jnp.int32)
     return paged_attention_ragged(
         q, k_cache, v_cache, block_tables, q_start, q_len, seq_lens,
-        block_size=block_size, max_q_len=1, q_tile=1, interpret=interpret,
+        block_size=block_size, max_q_len=1, q_tile=1, kv_tile=kv_tile,
+        interpret=interpret,
     )

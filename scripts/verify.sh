@@ -23,6 +23,9 @@
 #                                 # suite: epoch guard, wire integrity,
 #                                 # chaos storms; echoes the repro seed
 #                                 # (DYNTPU_CHAOS_SEED=<n>) on failure
+#   scripts/verify.sh tune        # kernel tile autotune (CPU bitwise
+#                                 # parity sweep in a fusion-disabled
+#                                 # subprocess) + adaptive bucket ladders
 set -u
 
 cd "$(dirname "$0")/.."
@@ -39,6 +42,11 @@ fi
 
 if [ "${1:-}" = "kernel" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernel \
+        -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "tune" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tune \
         -p no:cacheprovider
 fi
 

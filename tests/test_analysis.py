@@ -156,6 +156,77 @@ def test_dt102_quiet_for_host_numpy():
     assert lint(src, path=HOT, select=["DT102"]) == []
 
 
+def test_dt103_decorated_jit_missing_donation():
+    src = """
+    import jax
+    @jax.jit
+    def step(params, cache, tokens):
+        return cache, tokens
+    """
+    fs = lint(src, path=HOT, select=["DT103"])
+    assert codes(fs) == ["DT103"] and "`cache`" in fs[0].message
+
+
+def test_dt103_quiet_when_donated_by_num_or_name():
+    src = """
+    import jax
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tokens):
+        return cache, tokens
+
+    def make(cfg):
+        def window(params, cache, ctl, rows):
+            return cache, ctl
+        return jax.jit(window, donate_argnames=("cache", "ctl"))
+    """
+    assert lint(src, path=HOT, select=["DT103"]) == []
+
+
+def test_dt103_resolves_factory_call_idiom():
+    src = """
+    import jax
+    def raw_window_fn(cfg, eng):
+        def window(params, cache, ctl, rows):
+            return cache, ctl
+        return window
+
+    def make_window_fn(cfg, eng):
+        return jax.jit(raw_window_fn(cfg, eng), donate_argnums=(1,))
+    """
+    # ctl (index 2) is not donated — and the finding lands on the jit
+    # call site so a same-line waiver can reach it
+    fs = lint(src, path=HOT, select=["DT103"])
+    assert codes(fs) == ["DT103"] and "`ctl`" in fs[0].message
+    assert "jax.jit" in fs[0].snippet
+
+
+def test_dt103_waiver_and_bound_params_and_cold_scope():
+    waived = """
+    import jax
+    def make(cfg):
+        def extract(cache, ids):
+            return cache
+        return jax.jit(extract)  # dynalint: disable=DT103
+    """
+    assert lint(waived, path=HOT, select=["DT103"]) == []
+    # partial-bound leading args are consts, not buffers; cold modules
+    # are out of scope entirely
+    bound = """
+    import jax, functools
+    def helper(cache, tokens):
+        return tokens
+    fn = jax.jit(functools.partial(helper, CACHE_CONST))
+    """
+    assert lint(bound, path=HOT, select=["DT103"]) == []
+    hot_only = """
+    import jax
+    @jax.jit
+    def step(params, cache):
+        return cache
+    """
+    assert lint(hot_only, path=COLD, select=["DT103"]) == []
+
+
 # ---------------------------------------------------------------------------
 # DT2xx — recompile hazards
 

@@ -1,0 +1,329 @@
+"""Waste-driven adaptive bucket ladders (ISSUE 12 tentpole, part 2).
+
+Unit-level: BucketLadder split/retire mechanics, the compile budget,
+hysteresis on both edges, determinism over a seeded trace, and the
+cumulative-histogram ingest (including the warmup-reset re-baseline).
+
+Recorder-level: a fake-clock StepStats pair shows the before/after
+``padding_waste_ratio`` drop the split buys.
+
+Engine-level: a live InferenceEngine with ``adaptive_buckets=True``
+splits its decode rung under 1-row traffic, pays exactly the budgeted
+steady recompile (watchdog-attributed), converges, and then holds
+``compilewatch.assert_no_recompiles`` over further traffic.
+"""
+
+import random
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.ladder import BucketLadder
+from dynamo_tpu.observability.flops import FlopsModel
+from dynamo_tpu.observability.stepstats import (
+    DECODE,
+    SPEC_VERIFY,
+    StepRecord,
+    StepStats,
+)
+
+pytestmark = pytest.mark.tune
+
+
+def _ladder(**over):
+    kw = dict(kinds=(DECODE, SPEC_VERIFY), compile_budget=2,
+              split_waste=0.25, retire_share=0.02, min_dispatches=8,
+              hysteresis=2, step=8)
+    kw.update(over)
+    base = kw.pop("base", (64,))
+    return BucketLadder("decode", base, **kw)
+
+
+# ---------------------------------------------------------------------------
+# split / budget
+
+
+def test_split_on_hot_waste_inserts_mean_fill_rung():
+    lad = _ladder()
+    for _ in range(10):
+        lad.observe(64, real=24, padded=64)  # waste 0.625 > 0.25
+    events = lad.maybe_adapt()
+    assert [e["op"] for e in events] == ["split"]
+    assert events[0]["rung"] == 64 and events[0]["new"] == 24
+    assert lad.buckets() == (24, 64)
+    assert lad.snapshot()["budget_remaining"] == 1
+    # grid queries follow the new rung
+    assert lad.bucket_for(10) == 24 and lad.bucket_for(30) == 64
+    assert lad.rung_at_most(63) == 24
+    assert lad.rung_at_most(5) is None
+
+
+def test_compile_budget_is_never_exceeded():
+    lad = _ladder(compile_budget=1, hysteresis=1)
+    for _ in range(10):
+        lad.observe(64, real=24, padded=64)
+    assert lad.maybe_adapt()[0]["op"] == "split"
+    # another screaming-hot epoch: budget is spent, no more rungs
+    for _ in range(10):
+        lad.observe(24, real=4, padded=24)  # waste 0.83, mid 8 would fit
+    for _ in range(5):
+        events = lad.maybe_adapt()
+        assert not any(e["op"] == "split" for e in events)
+        for _ in range(10):
+            lad.observe(24, real=4, padded=24)
+    snap = lad.snapshot()
+    assert snap["splits_total"] == 1
+    assert snap["budget_remaining"] == 0
+    assert len(lad.buckets()) == 2
+
+
+def test_split_needs_room_between_neighbours():
+    # base rung == step floor: mean fill rounds up to the rung itself,
+    # so a (8,) decode grid can never split below its own step
+    lad = _ladder(base=(8,))
+    for _ in range(10):
+        lad.observe(8, real=1, padded=8)  # waste 0.875
+    assert lad.maybe_adapt() == []
+    assert lad.buckets() == (8,)
+
+
+def test_below_min_dispatches_is_a_noop():
+    lad = _ladder(min_dispatches=20)
+    for _ in range(10):
+        lad.observe(64, real=8, padded=64)
+    assert lad.maybe_adapt() == []   # evidence keeps accumulating
+    for _ in range(10):
+        lad.observe(64, real=8, padded=64)
+    assert [e["op"] for e in lad.maybe_adapt()] == ["split"]
+
+
+# ---------------------------------------------------------------------------
+# retire / hysteresis
+
+
+def test_retire_needs_consecutive_cold_epochs_and_spares_max_rung():
+    lad = _ladder(base=(8, 64), min_dispatches=4, hysteresis=2)
+    # all traffic lands in 64 (low waste, so no split competes)
+    for _ in range(5):
+        lad.observe(64, real=60, padded=64)
+    assert lad.maybe_adapt() == []          # rung 8 cold streak = 1
+    for _ in range(5):
+        lad.observe(64, real=60, padded=64)
+    events = lad.maybe_adapt()              # streak = 2 -> retire
+    assert [e["op"] for e in events] == ["retire"]
+    assert events[0]["rung"] == 8
+    assert lad.buckets() == (64,)
+    # the capacity rung is permanent no matter how cold it looks:
+    # park all traffic on a fresh small rung and starve 64 forever
+    lad2 = _ladder(base=(8, 64), min_dispatches=4, hysteresis=1)
+    for _ in range(6):
+        for _ in range(5):
+            lad2.observe(8, real=7, padded=8)
+        lad2.maybe_adapt()
+    assert 64 in lad2.buckets()
+
+
+def test_no_flapping_retired_value_not_readded_within_hysteresis():
+    lad = _ladder(base=(8, 64), min_dispatches=4, hysteresis=2)
+
+    def hot_epoch():
+        # rung 64 runs nearly empty: waste 0.94, mean fill rounds to 8
+        for _ in range(5):
+            lad.observe(64, real=4, padded=64)
+        return lad.maybe_adapt()
+
+    assert hot_epoch() == []                 # mid == existing lower rung 8
+    assert [e["op"] for e in hot_epoch()] == ["retire"]   # 8 goes cold
+    assert lad.buckets() == (64,)
+    # the very next epoch wants 8 back — hysteresis refuses the flap
+    assert hot_epoch() == []
+    # once the retired value has cooled for `hysteresis` epochs it may
+    # return (the workload really does want it)
+    events = hot_epoch()
+    assert [e["op"] for e in events] == ["split"]
+    assert events[0]["new"] == 8
+    assert lad.buckets() == (8, 64)
+
+
+def test_converged_after_quiet_epochs():
+    lad = _ladder(min_dispatches=4, hysteresis=2)
+    for _ in range(6):
+        lad.observe(64, real=24, padded=64)
+    lad.maybe_adapt()                        # split -> event this epoch
+    assert not lad.converged
+    for _ in range(4):                       # quiet, well-packed epochs
+        for _ in range(6):
+            lad.observe(24, real=22, padded=24)
+        lad.maybe_adapt()
+    assert lad.converged
+    assert lad.snapshot()["converged"] is True
+
+
+# ---------------------------------------------------------------------------
+# determinism
+
+
+def test_same_seeded_trace_same_decisions():
+    rng = random.Random(1234)
+    trace = [rng.randint(1, 64) for _ in range(400)]
+
+    def run():
+        lad = _ladder(min_dispatches=16, hysteresis=2)
+        events = []
+        for i, real in enumerate(trace):
+            lad.observe(lad.bucket_for(real), real,
+                        lad.bucket_for(real))
+            if i % 20 == 19:
+                events.extend(lad.maybe_adapt())
+        return lad.buckets(), events
+
+    rungs_a, events_a = run()
+    rungs_b, events_b = run()
+    assert rungs_a == rungs_b
+    assert events_a == events_b
+
+
+# ---------------------------------------------------------------------------
+# recorder ingest
+
+
+def test_ingest_takes_deltas_and_filters_kinds():
+    lad = _ladder(min_dispatches=8)
+    occ = {"decode:64": (10, 240, 640), "prefill:16": (5, 50, 80)}
+    lad.ingest(occ)                          # prefill key is not ours
+    assert lad._acc == {64: [10, 240, 640]}
+    # cumulative counters: only the delta lands
+    lad.ingest({"decode:64": (12, 260, 768)})
+    assert lad._acc == {64: [12, 260, 768]}
+    assert [e["op"] for e in lad.maybe_adapt()] == ["split"]
+
+
+def test_ingest_rebaselines_after_warmup_reset():
+    lad = _ladder(min_dispatches=8)
+    lad.ingest({"decode:64": (10, 240, 640)})
+    lad.maybe_adapt()
+    # recorder reset (mark_warmup_done): counters go backwards — the
+    # ladder must re-baseline instead of booking a negative delta
+    lad.ingest({"decode:64": (1, 60, 64)})
+    assert lad._acc == {}
+    lad.ingest({"decode:64": (2, 120, 128)})
+    assert lad._acc == {64: [1, 60, 64]}
+    # spec_verify feeds the same (decode) ladder
+    lad.ingest({"spec_verify:64": (3, 30, 192)})
+    assert lad._acc[64] == [4, 90, 256]
+
+
+# ---------------------------------------------------------------------------
+# the point of the exercise: padding waste drops after a split
+
+
+def test_padding_waste_ratio_drops_after_split():
+    fm = FlopsModel(ModelConfig.tiny())
+    clock = lambda: 100.0
+
+    def drive(stats, bucket):
+        for i in range(10):
+            stats.commit(StepRecord(
+                kind=DECODE, t_dispatch=100.0, t_land=100.0,
+                bucket=bucket, rows=bucket, live_rows=24,
+                padded_tokens=bucket, real_tokens=24, goodput_tokens=24,
+                context_sum=24 * 32))
+        return stats.snapshot(max_age_s=0.0)["padding_waste_ratio"]
+
+    before_stats = StepStats(fm, clock=clock)
+    waste_before = drive(before_stats, bucket=64)
+
+    lad = _ladder(min_dispatches=8)
+    lad.ingest(before_stats.bucket_occupancy())
+    events = lad.maybe_adapt()
+    assert [e["op"] for e in events] == ["split"]
+    new_bucket = lad.bucket_for(24)
+    assert new_bucket == 24
+
+    waste_after = drive(StepStats(fm, clock=clock), bucket=new_bucket)
+    assert waste_before > 0.3
+    assert waste_after < waste_before
+    assert waste_after == 0.0               # 24 rows fill the 24 rung
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+
+
+from dynamo_tpu.engine.engine import InferenceEngine, Request  # noqa: E402
+from dynamo_tpu.observability import compilewatch  # noqa: E402
+
+
+@pytest.fixture
+def watch():
+    compilewatch.install()
+    w = compilewatch.get_watch()
+    w.reset()
+    yield w
+    w.reset()
+
+
+async def _run(engine, prompt, n=4):
+    req = Request(request_id=f"lad-{prompt[0]}-{len(prompt)}-{n}",
+                  token_ids=prompt, max_tokens=n, temperature=0.0,
+                  ignore_eos=True)
+    return [out.token_id async for out in engine.submit(req)]
+
+
+@pytest.mark.anyio
+async def test_engine_ladder_splits_converges_then_no_recompiles(
+        watch, monkeypatch):
+    """ISSUE 12 acceptance: under sustained 1-row decode traffic the
+    decode ladder splits its 16-rung down to 8 (one budgeted, attributed
+    steady recompile), converges, and further traffic recompiles
+    nothing."""
+    # knobs must be set before engine construction (read in __init__)
+    monkeypatch.setenv("DYNTPU_LADDER_MIN_DISPATCHES", "6")
+    monkeypatch.setenv("DYNTPU_LADDER_HYSTERESIS", "1")
+    engine = InferenceEngine(
+        ModelConfig.tiny(),
+        EngineConfig(
+            block_size=4, num_blocks=64, max_num_seqs=4,
+            max_num_batched_tokens=64, max_model_len=128,
+            decode_buckets=(16,), prefill_buckets=(16, 32),
+            adaptive_buckets=True, ladder_compile_budget=2,
+        ),
+    )
+    assert engine._ladders and engine._ladders["decode"].buckets() == (16,)
+    await engine.start()
+    try:
+        # minimal warmup in the exact steady-state shape (3-token prompt,
+        # 4 tokens): stays under min_dispatches so the grid is still
+        # pristine when measurement starts
+        assert len(await _run(engine, [5, 6, 7], n=4)) == 4
+        engine.mark_obs_warmup_done()
+
+        # sustained single-row decode: every dispatch pads 1 -> 16
+        dec = engine._ladders["decode"]
+        for i in range(30):
+            await _run(engine, [i + 1, i + 2, i + 3], n=4)
+            if dec.snapshot()["splits_total"] and dec.converged:
+                break
+        snap = engine.obs_snapshot()
+        assert snap["ladder_decode_splits_total"] == 1
+        assert snap["ladder_decode_rungs"] == (8, 16)
+        assert snap["ladder_decode_budget_remaining"] == 1
+        assert snap["ladder_decode_converged"] == 1
+
+        # the recorder saw both grids: padded-to-16 before the split,
+        # packed-to-8 after
+        occ = engine.obs.bucket_occupancy()
+        assert "decode:16" in occ and "decode:8" in occ
+
+        # the one steady recompile is the budgeted 8-rung trace, and the
+        # watchdog attributed it to the decode window family
+        steady = watch.steady_by_label()
+        assert steady, "expected the budgeted split recompile"
+        assert all("decode" in label for label in steady), steady
+
+        # converged grid: same-shape traffic from here compiles nothing
+        with compilewatch.assert_no_recompiles():
+            for i in range(3):
+                assert len(await _run(engine, [90 + i, 91, 92], n=4)) == 4
+    finally:
+        await engine.stop()
