@@ -82,4 +82,37 @@ class AdHocMesh(Rule):
                     " build it through the canonical layout module")
 
 
-RULES = [HardcodedAxisName(), AdHocMesh()]
+class AdHocPartitionSpec(Rule):
+    code = "DT503"
+    name = "ad-hoc-partition-spec"
+    rationale = ("an axis-carrying PartitionSpec built outside the layout "
+                 "module is a private opinion about tensor placement; when "
+                 "it disagrees with SpecLayout the compiler reconciles the "
+                 "two with an involuntary full rematerialization — route "
+                 "every spec through dynamo_tpu.parallel.layout")
+
+    def visit_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_layout_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.call_name(node) or ""
+            if not (name == "PartitionSpec"
+                    or name.endswith(".PartitionSpec")):
+                continue
+            # bare PartitionSpec() / PartitionSpec(None, ...) is the
+            # replicated spec — harmless; any other argument names axes
+            args_carry = any(
+                not (isinstance(a, ast.Constant) and a.value is None)
+                for a in node.args
+            ) or bool(node.keywords)
+            if args_carry:
+                yield ctx.finding(
+                    self.code, node,
+                    "axis-carrying PartitionSpec constructed outside "
+                    "dynamo_tpu/parallel/layout.py; use layout.spec() / "
+                    "SpecLayout helpers")
+
+
+RULES = [HardcodedAxisName(), AdHocMesh(), AdHocPartitionSpec()]

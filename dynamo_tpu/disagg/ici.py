@@ -40,10 +40,9 @@ from typing import Dict, Optional
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..engine.engine import _pow2_bucket
-from ..parallel.layout import AXIS_TP
+from ..parallel.layout import kv_blocks_sharding
 from ..utils.logging import get_logger
 
 log = get_logger("disagg.ici")
@@ -52,10 +51,6 @@ log = get_logger("disagg.ici")
 class StaleEpochError(RuntimeError):
     """The destination reservation was recycled (or resumed) before the
     transfer landed — writing now would corrupt another request's KV."""
-
-# data layout produced by the jitted extract: [L, N, KV, bs, hd];
-# KV heads (axis 2) carry the tensor-parallel sharding.
-_DATA_SPEC = P(None, None, AXIS_TP, None, None)
 
 
 class DevicePlane:
@@ -121,8 +116,11 @@ class DevicePlane:
         data = await src_loop.run_in_executor(src_engine._executor, _gather)
 
         if dst_engine is not src_engine:
-            sharding = NamedSharding(dst_engine.mesh, _DATA_SPEC)
-            # the cross-mesh hop: device-to-device copy + TP reshard in one
+            # the cross-mesh hop: device-to-device copy onto the layout's
+            # [L, N, KV, bs, hd] transfer spec — KV heads over tp, the
+            # same axis the destination cache shards, so the scatter
+            # never reshards
+            sharding = kv_blocks_sharding(dst_engine.mesh)
             data = jax.device_put(data, {"k": sharding, "v": sharding})
 
         def _scatter():

@@ -109,8 +109,9 @@ class EngineConfig:
     decode_buckets: Tuple[int, ...] = (8, 16, 32, 64)
     # prefill chunk lengths likewise bucketed (powers of two)
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
-    # sharding: (data, tensor) mesh axis sizes; (1, 1) = single chip
-    mesh_shape: Tuple[int, int] = (1, 1)
+    # sharding: (dp, tp) or (dp, fsdp, tp) mesh axis sizes; (1, 1) =
+    # single chip. Axis semantics live in parallel/layout.py (SpecLayout)
+    mesh_shape: Tuple[int, ...] = (1, 1)
     # decode attention implementation: "pallas" streams KV blocks HBM→VMEM
     # with online softmax (ops/paged_attention.py); "einsum" materialises the
     # gathered context (the XLA-fusion reference path); "auto" microprobes
@@ -192,7 +193,12 @@ class EngineConfig:
     spec_hist_cap: int = 0
 
     def __post_init__(self):
-        if self.pp_stages > 1 and self.mesh_shape != (1, 1):
+        if len(self.mesh_shape) not in (2, 3):
+            raise ValueError("mesh_shape must be (dp, tp) or (dp, fsdp, tp)")
+        mesh_devices = 1
+        for n in self.mesh_shape:
+            mesh_devices *= n
+        if self.pp_stages > 1 and mesh_devices > 1:
             raise ValueError("pp_stages and a (dp, tp) mesh are exclusive")
         if self.max_num_seqs > max(self.decode_buckets):
             raise ValueError("max_num_seqs exceeds largest decode bucket")

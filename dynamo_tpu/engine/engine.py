@@ -28,6 +28,7 @@ import numpy as np
 from .. import tracing
 from ..observability import compilewatch
 from ..observability import flops as obs_flops
+from ..parallel import layout
 from ..observability.flops import FlopsModel
 from ..observability.stepstats import (
     DECODE, PREFILL, SPEC_VERIFY, StepRecord, StepStats,
@@ -1015,8 +1016,7 @@ class InferenceEngine(EngineCore):
                 if engine_config.pipeline_depth > 1:
                     log.info("spec_mode=ngram forces pipeline_depth=1")
                 self.scheduler.spec_plan_window = self._spec_k + 1
-            from jax.sharding import NamedSharding, PartitionSpec
-            repl = NamedSharding(self.mesh, PartitionSpec())
+            repl = layout.replicated(self.mesh)
             self._ctl = jax.device_put(
                 model_lib.init_ctl(
                     engine_config, engine_config.max_num_seqs,
@@ -1118,7 +1118,7 @@ class InferenceEngine(EngineCore):
             self._kv_extract = self._kv_inject = None
         else:
             self._kv_extract, self._kv_inject = model_lib.make_kv_ops(
-                engine_config
+                engine_config, self.mesh
             )
 
     def _shutdown_executor(self) -> None:
@@ -1216,7 +1216,9 @@ class InferenceEngine(EngineCore):
         with generation steps). Inputs are bucketed to powers of two so XLA
         compiles O(log T) encode programs."""
         if self._encode_fn is None:
-            self._encode_fn = model_lib.make_encode_fn(self.model_config)
+            self._encode_fn = model_lib.make_encode_fn(
+                self.model_config, None if self.pp > 1 else self.mesh
+            )
         loop = asyncio.get_running_loop()
 
         for ids in token_ids_batch:

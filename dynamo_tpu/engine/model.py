@@ -36,10 +36,11 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..observability import compilewatch
-from ..parallel.layout import AXIS_SP, AXIS_TP, make_flat_mesh, make_mesh
+from ..parallel import layout
+from ..parallel.layout import AXIS_TP, SpecLayout, make_mesh
 from .config import EngineConfig, ModelConfig
 
 Params = Dict[str, Any]
@@ -118,46 +119,51 @@ def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
 
 
 def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
-    """Megatron-style column/row TP over the ``tp`` mesh axis."""
-    def s(*spec):
-        return NamedSharding(mesh, P(*spec))
-
-    layers: Params = {
-        "attn_norm": s(None, None),
-        "wq": s(None, None, AXIS_TP),
-        "wk": s(None, None, AXIS_TP),
-        "wv": s(None, None, AXIS_TP),
-        "wo": s(None, AXIS_TP, None),
-        "mlp_norm": s(None, None),
-    }
-    if cfg.is_moe:
-        # expert parallelism: experts sharded over the model axis; the
-        # dispatch/combine einsums become all-to-alls under GSPMD
-        layers["w_router"] = s(None, None, None)
-        layers["w_gate"] = s(None, AXIS_TP, None, None)
-        layers["w_up"] = s(None, AXIS_TP, None, None)
-        layers["w_down"] = s(None, AXIS_TP, None, None)
-    else:
-        layers["w_gate"] = s(None, None, AXIS_TP)
-        layers["w_up"] = s(None, None, AXIS_TP)
-        layers["w_down"] = s(None, AXIS_TP, None)
-    shardings: Params = {
-        "embed": s(None, None),
-        "layers": layers,
-        "final_norm": s(None),
-    }
-    if not cfg.tie_word_embeddings:
-        shardings["lm_head"] = s(None, AXIS_TP)
-    return shardings
+    """The canonical per-parameter table (see ``SpecLayout``): Megatron
+    column/row TP over ``tp``, parameter storage over ``fsdp`` when the
+    mesh carries one, vocab-sharded embed/lm_head."""
+    return SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
 
 
 def cache_shardings(mesh: Mesh, cfg: ModelConfig) -> Cache:
     # KV heads sharded over tp so each shard holds the heads it computes
-    spec = NamedSharding(mesh, P(None, AXIS_TP, None, None))
+    return SpecLayout.for_mesh(mesh).cache_shardings(mesh, cfg)
+
+
+def _multi(mesh: Optional[Mesh]) -> bool:
+    """Explicit in/out shardings only pay off (and only typecheck against
+    axis names) on a real multi-device mesh."""
+    return mesh is not None and mesh.devices.size > 1
+
+
+def _io_kwargs(mesh: Optional[Mesh], cfg: ModelConfig, n_repl_in: int,
+               outs: Tuple[str, ...]) -> Dict[str, Any]:
+    """``jax.jit`` in/out sharding kwargs for a step-family function whose
+    leading args are (params, cache) followed by ``n_repl_in`` replicated
+    data/control args. ``outs`` names each output: "cache" (paged-cache
+    layout) or "repl". Pinning both sides to the canonical layout means a
+    mis-sharded arg is resharded at the boundary instead of silently
+    recompiling a differently-partitioned program."""
+    if not _multi(mesh):
+        return {}
+    lay = SpecLayout.for_mesh(mesh)
+    repl = layout.replicated(mesh)
+    pick = {"cache": lay.cache_shardings(mesh, cfg), "repl": repl}
     return {
-        "k": [spec] * cfg.num_layers,
-        "v": [spec] * cfg.num_layers,
+        "in_shardings": (
+            lay.param_shardings(mesh, cfg),
+            lay.cache_shardings(mesh, cfg),
+        ) + (repl,) * n_repl_in,
+        "out_shardings": tuple(pick[o] for o in outs),
     }
+
+
+def _repl_kwargs(mesh: Optional[Mesh], n_in: int) -> Dict[str, Any]:
+    """All-replicated in/out shardings (control-state updates)."""
+    if not _multi(mesh):
+        return {}
+    repl = layout.replicated(mesh)
+    return {"in_shardings": (repl,) * n_in, "out_shardings": repl}
 
 
 def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
@@ -308,15 +314,16 @@ def _paged_decode_attention(
     )
     q3 = q[:, 0]  # [B, H, hd]
     if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
-        out = jax.shard_map(
+        lay = SpecLayout.for_mesh(mesh)
+        heads = layout.spec(None, lay.tp, None)
+        out = layout.shard_map(
             lambda q_, k_, v_, t_, s_: kernel(q_, k_, v_, t_, s_),
             mesh=mesh,
             in_specs=(
-                P(None, AXIS_TP, None), P(None, AXIS_TP, None, None),
-                P(None, AXIS_TP, None, None), P(None, None), P(None),
+                heads, lay.cache_block(), lay.cache_block(),
+                layout.spec(None, None), layout.spec(None),
             ),
-            out_specs=P(None, AXIS_TP, None),
-            check_vma=False,  # pallas_call outputs carry no vma info
+            out_specs=heads,
         )(q3, lk, lv, block_tables, seq_lens)
     else:
         out = kernel(q3, lk, lv, block_tables, seq_lens)
@@ -356,18 +363,19 @@ def _paged_ragged_attention(
     q_flat = q.reshape(B * T, H, hd)
     q_start = jnp.arange(B + 1, dtype=jnp.int32) * T
     if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
-        out = jax.shard_map(
+        lay = SpecLayout.for_mesh(mesh)
+        heads = layout.spec(None, lay.tp, None)
+        out = layout.shard_map(
             lambda q_, k_, v_, t_, s_, ql_, cl_: kernel(
                 q_, k_, v_, t_, s_, ql_, cl_
             ),
             mesh=mesh,
             in_specs=(
-                P(None, AXIS_TP, None), P(None, AXIS_TP, None, None),
-                P(None, AXIS_TP, None, None), P(None, None), P(None),
-                P(None), P(None),
+                heads, lay.cache_block(), lay.cache_block(),
+                layout.spec(None, None), layout.spec(None),
+                layout.spec(None), layout.spec(None),
             ),
-            out_specs=P(None, AXIS_TP, None),
-            check_vma=False,  # pallas_call outputs carry no vma info
+            out_specs=heads,
         )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
     else:
         out = kernel(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
@@ -406,6 +414,20 @@ def forward(
     H, KV = cfg.num_heads, cfg.num_kv_heads
 
     use_ring = ring_mesh is not None and T > 1
+    ring_lay = SpecLayout.for_mesh(ring_mesh) if use_ring else None
+    if use_ring and ring_lay.seq_axes() is None:
+        use_ring = ring_lay = None  # single-device "ring" is dense attention
+    # layer-boundary activation pin: ring chunks stay T-sharded over the
+    # SERVING mesh's composite sequence axis, dense-path activations stay
+    # replicated — one spec per boundary means GSPMD never has to guess
+    # (and never falls back to involuntary rematerialization)
+    lay = SpecLayout.for_mesh(mesh) if _multi(mesh) else None
+    if use_ring:
+        h_pin = NamedSharding(ring_mesh, ring_lay.hidden_seq())
+    elif lay is not None:
+        h_pin = NamedSharding(mesh, lay.hidden())
+    else:
+        h_pin = None
 
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
     if mm_embeds is not None:
@@ -414,11 +436,8 @@ def forward(
         # TRT-LLM EPD flow, request_handlers/handler_base.py:64-234 — the
         # reference splices prompt embeddings the same way)
         h = jnp.where(mm_mask[..., None], mm_embeds.astype(h.dtype), h)
-    if use_ring:
-        # pin activations T-sharded so the whole layer stack stays O(T/sp)
-        h = jax.lax.with_sharding_constraint(
-            h, NamedSharding(ring_mesh, P(None, AXIS_SP, None))
-        )
+    if h_pin is not None:
+        h = jax.lax.with_sharding_constraint(h, h_pin)
 
     # physical (block, offset) per (b, t); pads go to the trash block 0
     pos_safe = jnp.maximum(positions, 0)
@@ -459,25 +478,49 @@ def forward(
         v = (x @ p["wv"]).reshape(B, T, KV, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        if use_ring:
+            # projections of the T-sharded chunk stay T-sharded — without
+            # the pin the column-sharded wq/wk/wv propagate a head
+            # sharding into the same tensors and GSPMD remats
+            qkv_pin = NamedSharding(ring_mesh, ring_lay.heads_seq())
+            q = jax.lax.with_sharding_constraint(q, qkv_pin)
+            k = jax.lax.with_sharding_constraint(k, qkv_pin)
+            v = jax.lax.with_sharding_constraint(v, qkv_pin)
 
         # scatter this chunk's K/V into the paged cache
-        lk = lk.at[scatter_block, :, scatter_off].set(
-            k.reshape(B * T, KV, hd)
-        )
-        lv = lv.at[scatter_block, :, scatter_off].set(
-            v.reshape(B * T, KV, hd)
-        )
+        k_upd = k.reshape(B * T, KV, hd)
+        v_upd = v.reshape(B * T, KV, hd)
+        if use_ring and lay is not None:
+            # the one real layout change on the ring path: T-sharded K/V
+            # re-lands on the cache's head sharding. GSPMD cannot
+            # synthesize the seq->heads transform in one hop (it falls
+            # back to involuntary full rematerialization), so stage it
+            # explicitly: a planned all-gather over the sequence axes,
+            # then a local slice onto the cache's tp sharding
+            repl_pin = NamedSharding(mesh, layout.spec(None, None, None))
+            upd_pin = NamedSharding(mesh, layout.spec(None, lay.tp, None))
+            k_upd = jax.lax.with_sharding_constraint(k_upd, repl_pin)
+            v_upd = jax.lax.with_sharding_constraint(v_upd, repl_pin)
+            k_upd = jax.lax.with_sharding_constraint(k_upd, upd_pin)
+            v_upd = jax.lax.with_sharding_constraint(v_upd, upd_pin)
+        lk = lk.at[scatter_block, :, scatter_off].set(k_upd)
+        lv = lv.at[scatter_block, :, scatter_off].set(v_upd)
 
         if use_ring:
             from ..parallel.ring_attention import ring_attention
 
-            spec = P(None, AXIS_SP, None, None)
-            attn = jax.shard_map(
-                functools.partial(ring_attention, axis_name=AXIS_SP),
+            # the ring runs over the serving mesh itself — the sequence
+            # axis is the composite (dp, tp) [..fsdp] axes, so the K/V the
+            # scatter reshards into the head-sharded cache never crosses a
+            # mesh boundary (THE involuntary-remat source this replaces)
+            seq_spec = ring_lay.heads_seq()
+            attn = layout.shard_map(
+                functools.partial(
+                    ring_attention, axis_name=ring_lay.seq_axes()
+                ),
                 mesh=ring_mesh,
-                in_specs=(spec, spec, spec),
-                out_specs=spec,
-                check_vma=False,
+                in_specs=(seq_spec, seq_spec, seq_spec),
+                out_specs=seq_spec,
             )(q, k, v)
         elif use_pallas and T == 1:
             attn = _paged_decode_attention(
@@ -518,7 +561,20 @@ def forward(
         else:
             gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
             up = (x @ p["w_up"]).astype(jnp.float32)
+            if use_ring:
+                # ring chunks run the MLP sequence-parallel: activations
+                # stay T-sharded, the (small) weights all-gather — pin the
+                # intermediates so w_down's row sharding can't pull a
+                # head-style spec onto them
+                ff_pin = NamedSharding(
+                    ring_mesh,
+                    layout.spec(None, ring_lay.seq_axes(), None),
+                )
+                gate = jax.lax.with_sharding_constraint(gate, ff_pin)
+                up = jax.lax.with_sharding_constraint(up, ff_pin)
             h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+        if h_pin is not None:
+            h = jax.lax.with_sharding_constraint(h, h_pin)
         new_k.append(lk)
         new_v.append(lv)
 
@@ -596,10 +652,19 @@ def encode_forward(
     return pooled / jnp.maximum(norm, 1e-12)
 
 
-def make_encode_fn(cfg: ModelConfig):
-    """Jitted encode step: (params, tokens[B,T], positions[B,T]) -> [B, D]."""
+def make_encode_fn(cfg: ModelConfig, mesh: Optional[Mesh] = None):
+    """Jitted encode step: (params, tokens[B,T], positions[B,T]) -> [B, D].
+
+    ``mesh`` pins params to the canonical layout (pooled embeddings are
+    tiny and come back replicated)."""
+    kw: Dict[str, Any] = {}
+    if _multi(mesh):
+        lay = SpecLayout.for_mesh(mesh)
+        repl = layout.replicated(mesh)
+        kw["in_shardings"] = (lay.param_shardings(mesh, cfg), repl, repl)
+        kw["out_shardings"] = repl
     return compilewatch.label(
-        jax.jit(functools.partial(encode_forward, cfg)), "encode"
+        jax.jit(functools.partial(encode_forward, cfg), **kw), "encode"
     )
 
 
@@ -779,10 +844,15 @@ def raw_multistep_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
 def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     """Jitted step with the cache donated — XLA updates it in place.
 
-    params+cache carry their shardings from device_put; data args are small
-    host arrays XLA replicates, so no explicit in_shardings are needed."""
+    On a multi-device mesh both sides of the jit boundary are pinned to the
+    canonical ``SpecLayout``: params/cache in their table layout, data args
+    replicated, the updated cache back out in the cache layout."""
     return compilewatch.label(
-        jax.jit(raw_step_fn(cfg, eng, mesh), donate_argnums=(1,)), "step"
+        jax.jit(
+            raw_step_fn(cfg, eng, mesh), donate_argnums=(1,),
+            **_io_kwargs(mesh, cfg, 9, ("cache", "repl")),
+        ),
+        "step",
     )
 
 
@@ -871,7 +941,8 @@ def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
     """Jitted ring decode window; cache and ring buffer donated."""
     return compilewatch.label(
         jax.jit(
-            raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+            raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2),
+            **_io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl")),
         ),
         "ring_decode_window",
     )
@@ -1018,12 +1089,17 @@ def make_autopilot_fns(cfg: ModelConfig, eng: EngineConfig, K: int,
     """(window_fn, delta_fn) jitted with cache/ctl donated."""
     window = compilewatch.label(
         jax.jit(
-            raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2)
+            raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2),
+            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl")),
         ),
         "decode_window",
     )
     delta = compilewatch.label(
-        jax.jit(raw_ctl_delta_fn(Wcap), donate_argnums=(0,)), "ctl_delta"
+        jax.jit(
+            raw_ctl_delta_fn(Wcap), donate_argnums=(0,),
+            **_repl_kwargs(mesh, 3),
+        ),
+        "ctl_delta",
     )
     return window, delta
 
@@ -1160,11 +1236,15 @@ def make_spec_fns(cfg: ModelConfig, eng: EngineConfig, k: int,
         jax.jit(
             raw_spec_window_fn(cfg, eng, k, ngram_min, ngram_max, mesh),
             donate_argnums=(1, 2),
+            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl")),
         ),
         "spec_window",
     )
     fill = compilewatch.label(
-        jax.jit(raw_spec_hist_fill_fn(), donate_argnums=(0,)),
+        jax.jit(
+            raw_spec_hist_fill_fn(), donate_argnums=(0,),
+            **_repl_kwargs(mesh, 3),
+        ),
         "spec_hist_fill",
     )
     return window, fill
@@ -1252,7 +1332,9 @@ def make_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                            T: int, W: int, mesh: Optional[Mesh] = None):
     return compilewatch.label(
         jax.jit(
-            raw_packed_prefill_fn(cfg, eng, T, W, mesh), donate_argnums=(1, 2)
+            raw_packed_prefill_fn(cfg, eng, T, W, mesh),
+            donate_argnums=(1, 2),
+            **_io_kwargs(mesh, cfg, 3, ("cache", "repl", "repl")),
         ),
         f"packed_prefill_T{T}_W{W}",
     )
@@ -1262,9 +1344,10 @@ def make_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
                          mesh: Optional[Mesh] = None,
                          ring_mesh: Optional[Mesh] = None,
                          out_shardings=None):
-    """Jitted ring prefill; cache + ring donated. ``out_shardings`` pins
-    the sp path's cache layout (see ``make_sp_prefill_fn``)."""
-    kw = {}
+    """Jitted ring prefill; cache + ring donated. ``out_shardings``
+    overrides the canonical output layout if a caller needs to (the sp
+    path's defaults already pin the serving cache layout)."""
+    kw = _io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl"))
     if out_shardings is not None:
         kw["out_shardings"] = out_shardings
     return compilewatch.label(
@@ -1302,7 +1385,13 @@ def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         )
         return cache, sampled
 
-    return compilewatch.label(jax.jit(step, donate_argnums=(1,)), "mm_prefill")
+    return compilewatch.label(
+        jax.jit(
+            step, donate_argnums=(1,),
+            **_io_kwargs(mesh, cfg, 11, ("cache", "repl")),
+        ),
+        "mm_prefill",
+    )
 
 
 def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
@@ -1332,28 +1421,31 @@ def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         return cache, last_tok, sampled
 
     return compilewatch.label(
-        jax.jit(step, donate_argnums=(1, 2)), "mm_ring_prefill"
+        jax.jit(
+            step, donate_argnums=(1, 2),
+            **_io_kwargs(mesh, cfg, 14, ("cache", "repl", "repl")),
+        ),
+        "mm_ring_prefill",
     )
 
 
 def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
     """Jitted full-prompt sequence-parallel prefill step.
 
-    The same dp×tp device set is viewed as one flat ``sp`` ring; the cache's
-    out_shardings are pinned to the serving layout so subsequent decode
-    steps see an unchanged (donated) cache. SURVEY §5 long-context; exact —
-    ring attention accumulates online softmax in f32.
+    The ring runs over the SERVING mesh itself: the chunk's T axis is
+    sharded over the composite (dp, tp) [..fsdp] axes (``SpecLayout.
+    seq_axes``) — NOT over a second flat ``sp`` mesh on the same devices,
+    which GSPMD could only reconcile with the head-sharded cache by fully
+    rematerializing every crossing tensor (the MULTICHIP_r05 storm). The
+    cache's out_shardings pin the serving layout so subsequent decode
+    steps see an unchanged (donated) cache. SURVEY §5 long-context;
+    exact — ring attention accumulates online softmax in f32.
     """
-    sp_mesh = make_flat_mesh(mesh.devices, AXIS_SP)
-    out_shardings = (
-        cache_shardings(mesh, cfg),
-        NamedSharding(mesh, P()),
-    )
     return compilewatch.label(
         jax.jit(
-            raw_step_fn(cfg, eng, mesh, ring_mesh=sp_mesh),
+            raw_step_fn(cfg, eng, mesh, ring_mesh=mesh),
             donate_argnums=(1,),
-            out_shardings=out_shardings,
+            **_io_kwargs(mesh, cfg, 9, ("cache", "repl")),
         ),
         "sp_prefill",
     )
@@ -1361,15 +1453,7 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
 
 def make_sp_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
     """Ring-posting variant of the sp prefill (pipelined serving path)."""
-    sp_mesh = make_flat_mesh(mesh.devices, AXIS_SP)
-    out_shardings = (
-        cache_shardings(mesh, cfg),
-        NamedSharding(mesh, P()),   # last_tok
-        NamedSharding(mesh, P()),   # sampled
-    )
-    return make_ring_prefill_fn(
-        cfg, eng, mesh, ring_mesh=sp_mesh, out_shardings=out_shardings
-    )
+    return make_ring_prefill_fn(cfg, eng, mesh, ring_mesh=mesh)
 
 
 # ------------------------ KV block transfer ops ---------------------------
@@ -1382,15 +1466,25 @@ def make_sp_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
 # the same jitted fns ride ICI when source and destination share a mesh.
 
 
-def make_kv_ops(eng: EngineConfig):
+def make_kv_ops(eng: EngineConfig, mesh: Optional[Mesh] = None):
     """(extract, inject) jitted block gather/scatter over the paged cache.
 
     extract(cache, block_ids[N]) -> {"k","v"}: [L, N, KV, bs, hd]
     inject(cache, block_ids[N], data) -> cache  (donated, in-place scatter)
 
     In the block-major layout these are single-axis gathers/scatters over
-    whole contiguous blocks — XLA lowers them to block-granular DMA.
+    whole contiguous blocks — XLA lowers them to block-granular DMA. With
+    a mesh, extract pins the transfer payload to ``SpecLayout.kv_blocks``
+    (KV heads over tp — the same axis the cache shards) and inject pins
+    the cache back to its serving layout, so the disagg handoff agrees
+    with the cache about head placement on both ends.
     """
+    kw_ex: Dict[str, Any] = {}
+    kw_in: Dict[str, Any] = {}
+    if _multi(mesh):
+        lay = SpecLayout.for_mesh(mesh)
+        kw_ex["out_shardings"] = NamedSharding(mesh, lay.kv_blocks())
+        kw_in["out_shardings"] = NamedSharding(mesh, lay.cache_block())
 
     def extract(cache: Cache, block_ids: jax.Array) -> Cache:
         return {
@@ -1411,8 +1505,8 @@ def make_kv_ops(eng: EngineConfig):
     return (
         # read-only gather: the serving engine keeps using the cache after
         # an extract, so donating it here would free live KV
-        compilewatch.label(jax.jit(extract), "kv_extract"),  # dynalint: disable=DT103
+        compilewatch.label(jax.jit(extract, **kw_ex), "kv_extract"),  # dynalint: disable=DT103
         compilewatch.label(
-            jax.jit(inject, donate_argnums=(0,)), "kv_inject"
+            jax.jit(inject, donate_argnums=(0,), **kw_in), "kv_inject"
         ),
     )

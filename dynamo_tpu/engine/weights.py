@@ -30,6 +30,10 @@ log = get_logger("engine.weights")
 
 Params = Dict[str, Any]
 
+# stats from the most recent load_hf_params_sharded call (tests pin
+# peak_staging_bytes to one checkpoint tensor)
+last_load_stats: Dict[str, Any] = {}
+
 
 def _np_dtype(name: str):
     if name == "bfloat16":
@@ -68,6 +72,41 @@ def _stacked_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
             "w_down": (L, F, D),
         })
     return layers
+
+
+def _param_shapes(cfg: ModelConfig) -> Dict[str, Any]:
+    """Full param-tree shapes matching ``model.init_params(cfg)``."""
+    D, V = cfg.hidden_size, cfg.vocab_size
+    shapes: Dict[str, Any] = {
+        "embed": (V, D),
+        "layers": _stacked_shapes(cfg),
+        "final_norm": (D,),
+    }
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head"] = (D, V)
+    return shapes
+
+
+def abstract_params(cfg: ModelConfig, mesh=None) -> Params:
+    """``jax.ShapeDtypeStruct`` tree for the param pytree — with a mesh,
+    each leaf carries its ``SpecLayout`` NamedSharding, so orbax restores
+    (and the streaming HF loader) land directly on device shards."""
+    import jax
+
+    dt = jnp.dtype(cfg.dtype)
+    tree = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s, dt), _param_shapes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    if mesh is not None and mesh.devices.size > 1:
+        from ..parallel.layout import SpecLayout
+
+        shardings = SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
+        tree = jax.tree.map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            tree, shardings,
+        )
+    return tree
 
 
 def _dest(cfg: ModelConfig, name: str):
@@ -167,6 +206,104 @@ def load_hf_params(path: str, cfg: ModelConfig) -> Params:
             for k, v in params.items()}
 
 
+def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh) -> Params:
+    """Stream an HF safetensors checkpoint directly onto device shards.
+
+    Each checkpoint tensor is staged on host exactly once — peak host
+    memory is the single largest tensor, never a replicated copy of the
+    model — then scattered into its preallocated device-sharded stacked
+    buffer with a donated jitted ``.at[i].set``. The buffer keeps its
+    ``SpecLayout`` layout throughout, so the engine can serve straight
+    from the returned tree with zero resharding.
+    """
+    import jax
+    from safetensors import safe_open
+
+    from ..parallel.layout import SpecLayout
+
+    path = Path(path)
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    dt = _np_dtype(cfg.dtype)
+    shardings = SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
+
+    def _zeros(shape, sharding):
+        return jax.jit(
+            lambda: jnp.zeros(shape, dt), out_shardings=sharding
+        )()
+
+    layers = {
+        k: _zeros(shape, shardings["layers"][k])
+        for k, shape in _stacked_shapes(cfg).items()
+    }
+    top: Dict[str, Any] = {}
+
+    setters: Dict[Any, Any] = {}
+
+    def _setter(leaf: str, with_expert: bool):
+        key = (leaf, with_expert)
+        if key not in setters:
+            sh = shardings["layers"][leaf]
+            if with_expert:
+                fn = lambda buf, i, e, t: buf.at[i, e].set(t)
+            else:
+                fn = lambda buf, i, t: buf.at[i].set(t)
+            setters[key] = jax.jit(
+                fn, donate_argnums=(0,), out_shardings=sh
+            )
+        return setters[key]
+
+    n_seen = 0
+    peak = 0
+    for f in files:
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                dest = _dest(cfg, name)
+                if dest is None:
+                    continue
+                leaf, i, e, transpose = dest
+                t = sf.get_tensor(name)
+                if t.dtype == np.uint16:  # safetensors numpy bf16 fallback
+                    import ml_dtypes
+
+                    t = t.view(ml_dtypes.bfloat16)
+                if transpose:
+                    t = t.T
+                t = np.ascontiguousarray(t.astype(dt, copy=False))
+                peak = max(peak, t.nbytes)
+                if i is None:
+                    top[leaf] = jax.device_put(t, shardings[leaf])
+                elif e is None:
+                    layers[leaf] = _setter(leaf, False)(
+                        layers[leaf], i, t
+                    )
+                else:
+                    layers[leaf] = _setter(leaf, True)(
+                        layers[leaf], i, e, t
+                    )
+                n_seen += 1
+
+    params: Params = {
+        "embed": top["embed"],
+        "layers": layers,
+        "final_norm": top["final_norm"],
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = top["lm_head"]
+    last_load_stats.clear()
+    last_load_stats.update(
+        n_tensors=n_seen, n_files=len(files),
+        peak_staging_bytes=int(peak),
+    )
+    log.info(
+        "streamed %d tensors from %s onto %d devices "
+        "(peak host staging %.1f MiB)",
+        n_seen, path, mesh.devices.size, peak / 2**20,
+    )
+    return params
+
+
 def model_config_from_hf(path: str) -> ModelConfig:
     """Build a ModelConfig from an HF ``config.json``."""
     with open(Path(path) / "config.json") as f:
@@ -201,12 +338,22 @@ def save_checkpoint(path: str, params: Params) -> None:
     ckptr.wait_until_finished()
 
 
-def load_checkpoint(path: str, target: Optional[Params] = None) -> Params:
-    """Restore an orbax checkpoint; pass ``target`` (e.g. abstract arrays
-    with shardings) to restore directly onto a device mesh."""
+def load_checkpoint(
+    path: str,
+    target: Optional[Params] = None,
+    cfg: Optional[ModelConfig] = None,
+    mesh=None,
+) -> Params:
+    """Restore an orbax checkpoint. With ``cfg`` (and optionally ``mesh``),
+    the abstract restore target — shapes, dtypes, AND ``SpecLayout``
+    shardings — is built via :func:`abstract_params`, so orbax writes each
+    leaf straight onto its device shards with no host-replicated staging
+    copy. An explicit ``target`` overrides the derived one."""
     import orbax.checkpoint as ocp
 
     ckptr = ocp.StandardCheckpointer()
+    if target is None and cfg is not None:
+        target = abstract_params(cfg, mesh)
     if target is not None:
         return ckptr.restore(os.path.abspath(path), target)
     return ckptr.restore(os.path.abspath(path))

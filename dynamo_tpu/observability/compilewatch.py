@@ -23,7 +23,10 @@ total`` surface through the worker gauges and ``bench.py``'s
 from __future__ import annotations
 
 import logging
+import os
 import re
+import sys
+import tempfile
 import threading
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -219,6 +222,66 @@ def steady_total() -> int:
 
 def steady_by_label() -> Dict[str, int]:
     return _watch.steady_by_label()
+
+
+class _StderrCapture:
+    """Handle returned by :func:`capture_stderr`: ``.text()`` is everything
+    written to fd 2 inside the block (so far, or in total after exit)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._final: Optional[str] = None
+
+    def _freeze(self) -> None:
+        self._final = self.text()
+
+    def text(self) -> str:
+        if self._final is not None:
+            return self._final
+        sys.stderr.flush()
+        try:
+            with open(self._path, "r", errors="replace") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+
+@contextmanager
+def capture_stderr():
+    """Tee-free fd-level stderr capture.
+
+    XLA's ``[SPMD] Involuntary full rematerialization`` warnings are
+    emitted by C++ absl logging straight to file descriptor 2 — they never
+    pass through Python's ``sys.stderr`` or the logging bridge, so a
+    ``redirect_stderr`` misses them. This swaps fd 2 for a temp file via
+    ``os.dup2`` for the duration of the block and yields a handle whose
+    ``.text()`` can be fed to :func:`scan_log_text`.
+    """
+    sys.stderr.flush()
+    saved_fd = os.dup(2)
+    tmp = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".stderr", delete=False
+    )
+    cap = _StderrCapture(tmp.name)
+    try:
+        os.dup2(tmp.fileno(), 2)
+        yield cap
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved_fd, 2)
+        os.close(saved_fd)
+        cap._freeze()
+        tmp.close()
+        # replay the captured bytes onto the real stderr so the capture
+        # is observability, not a muzzle
+        text = cap.text()
+        if text:
+            sys.stderr.write(text)
+            sys.stderr.flush()
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
 
 
 @contextmanager

@@ -21,21 +21,24 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from . import layout
+from .layout import AXIS_PP
 
 
 def pipeline_stages(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,     # this device's stage params (leading axis sliced)
     x: jax.Array,          # [M, mb, ...] all microbatches (replicated input)
-    axis_name: str = "pp",
+    axis_name: str = AXIS_PP,
 ) -> jax.Array:
     """Per-shard pipeline body — call inside ``shard_map``.
 
     Returns the final-stage outputs ``[M, mb, ...]`` (replicated to every
     stage via a masked psum at the end).
     """
-    S = jax.lax.axis_size(axis_name)
+    S = layout.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     M = x.shape[0]
     fwd = [(j, (j + 1) % S) for j in range(S)]
@@ -70,7 +73,7 @@ def pipeline_stages(
 def make_pipeline(
     mesh: Mesh,
     stage_fn: Callable[[Any, jax.Array], jax.Array],
-    axis: str = "pp",
+    axis: str = AXIS_PP,
 ):
     """Jittable pipelined forward: ``f(params, x[M, mb, ...]) -> y``.
 
@@ -87,18 +90,18 @@ def make_pipeline(
         )
 
     def wrapped(params, x):
-        return jax.shard_map(
+        stage_spec = layout.spec(axis)
+        return layout.shard_map(
             run, mesh=mesh,
-            in_specs=(jax.tree.map(lambda _: P(axis), params), P()),
-            out_specs=P(),
-            check_vma=False,
+            in_specs=(jax.tree.map(lambda _: stage_spec, params),
+                      layout.spec()),
+            out_specs=layout.spec(),
         )(params, x)
 
     return jax.jit(wrapped)
 
 
-def stage_shardings(mesh: Mesh, params: Any, axis: str = "pp") -> Any:
+def stage_shardings(mesh: Mesh, params: Any, axis: str = AXIS_PP) -> Any:
     """NamedShardings putting each leaf's leading (stage) axis on ``axis``."""
-    return jax.tree.map(
-        lambda _: NamedSharding(mesh, P(axis)), params
-    )
+    stage = layout.named(mesh, axis)
+    return jax.tree.map(lambda _: stage, params)

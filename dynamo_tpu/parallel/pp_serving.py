@@ -38,10 +38,11 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..engine.config import EngineConfig, ModelConfig
 from ..engine import model as model_lib
+from . import layout
 from .layout import AXIS_PP, make_axes_mesh
 
 Cache = dict
@@ -62,25 +63,25 @@ def init_pp_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
 
 
 def pp_cache_shardings(mesh: Mesh, cfg: ModelConfig) -> Cache:
-    spec = NamedSharding(mesh, P("pp"))
-    return {"k": spec, "v": spec}
+    stage = layout.named(mesh, AXIS_PP)
+    return {"k": stage, "v": stage}
 
 
 def pp_param_shardings(mesh: Mesh, cfg: ModelConfig):
     """Layer stack over pp; everything else replicated."""
-    def s(*spec):
-        return NamedSharding(mesh, P(*spec))
+    stage = layout.named(mesh, AXIS_PP)
+    repl = layout.replicated(mesh)
 
     layer_names = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
     layer_names += (["w_router", "w_gate", "w_up", "w_down"]
                     if cfg.is_moe else ["w_gate", "w_up", "w_down"])
     shardings = {
-        "embed": s(),
-        "layers": {name: s("pp") for name in layer_names},
-        "final_norm": s(),
+        "embed": repl,
+        "layers": {name: stage for name in layer_names},
+        "final_norm": repl,
     }
     if not cfg.tie_word_embeddings:
-        shardings["lm_head"] = s()
+        shardings["lm_head"] = repl
     return shardings
 
 
@@ -146,7 +147,7 @@ def _stage_layers(cfg: ModelConfig, eng: EngineConfig, Lp: int,
 def raw_pp_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh,
                    num_microbatches: int = 4):
     """The pipelined unified step (same signature as raw_step_fn)."""
-    S = mesh.shape["pp"]
+    S = mesh.shape[AXIS_PP]
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp={S}"
@@ -170,7 +171,7 @@ def raw_pp_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh,
         tbl_mb = block_tables.reshape(M, mb, W)
 
         def body(stage_params, ck, cv, h_all, pos_all, tbl_all):
-            stage = jax.lax.axis_index("pp")
+            stage = jax.lax.axis_index(AXIS_PP)
             fwd = [(j, (j + 1) % S) for j in range(S)]
             lk, lv = ck, cv                          # [Lp, NB, KV, bs, hd]
             act = jnp.zeros_like(h_all[0])
@@ -203,18 +204,19 @@ def raw_pp_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh,
                 ]
                 out = jnp.where(bank & sel, act[None], out)
                 if t != M + S - 2:
-                    act = jax.lax.ppermute(act, "pp", fwd)
+                    act = jax.lax.ppermute(act, AXIS_PP, fwd)
             out = jnp.where(stage == S - 1, out, jnp.zeros_like(out))
-            return jax.lax.psum(out, "pp"), lk, lv
+            return jax.lax.psum(out, AXIS_PP), lk, lv
 
-        h_out, new_k, new_v = jax.shard_map(
+        stage_spec = layout.spec(AXIS_PP)
+        repl_spec = layout.spec()
+        h_out, new_k, new_v = layout.shard_map(
             body, mesh=mesh,
             in_specs=(
-                jax.tree.map(lambda _: P("pp"), params["layers"]),
-                P("pp"), P("pp"), P(), P(), P(),
+                jax.tree.map(lambda _: stage_spec, params["layers"]),
+                stage_spec, stage_spec, repl_spec, repl_spec, repl_spec,
             ),
-            out_specs=(P(), P("pp"), P("pp")),
-            check_vma=False,
+            out_specs=(repl_spec, stage_spec, stage_spec),
         )(params["layers"], cache["k"], cache["v"], h_mb, pos_mb, tbl_mb)
 
         h = h_out.reshape(B, T, D)

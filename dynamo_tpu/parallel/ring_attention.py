@@ -27,7 +27,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
+
+from . import layout
 
 
 def _block_attend(q, k, v, qpos, kpos, m, l, acc, scale, causal):
@@ -76,7 +78,7 @@ def ring_attention(
     attends over the chunk that started on device ``(i - s) mod n`` while
     sending its current chunk to neighbor ``i+1``.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = layout.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     B, C, H, hd = q.shape
     scale = 1.0 / np.sqrt(hd)
@@ -109,10 +111,9 @@ def make_ring_attention(
     """Jittable global-array ring attention: ``f(q, k, v) -> out`` with
     q/k/v ``[B, T, H|KV, hd]`` sharded over ``axis`` on T."""
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
-    spec = P(None, axis, None, None)
-    return jax.jit(jax.shard_map(
+    spec = layout.spec(None, axis, None, None)
+    return jax.jit(layout.shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     ))

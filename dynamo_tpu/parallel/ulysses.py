@@ -17,7 +17,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
+
+from . import layout
 
 
 def _full_attention(q, k, v, causal: bool):
@@ -49,7 +51,7 @@ def ulysses_attention(
 
     Requires ``H % sp == 0`` and ``KV % sp == 0``.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = layout.axis_size(axis_name)
     B, C, H, hd = q.shape
     KV = k.shape[2]
     if H % n or KV % n:
@@ -88,10 +90,9 @@ def make_ulysses_attention(
     """Jittable global-array Ulysses attention (same contract as
     ``make_ring_attention``)."""
     fn = functools.partial(ulysses_attention, axis_name=axis, causal=causal)
-    spec = P(None, axis, None, None)
-    return jax.jit(jax.shard_map(
+    spec = layout.spec(None, axis, None, None)
+    return jax.jit(layout.shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     ))

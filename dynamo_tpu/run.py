@@ -72,15 +72,27 @@ def build_output(args):
     from .engine.engine import InferenceEngine
 
     model_cfg = MODEL_PRESETS[args.model]()
+    dp, tp = (int(x) for x in args.mesh.split(","))
     params = None
     if args.weights:
-        from .engine.weights import load_hf_params, model_config_from_hf
+        from .engine.weights import (
+            load_hf_params, load_hf_params_sharded, model_config_from_hf,
+        )
         import os
 
         if os.path.exists(os.path.join(args.weights, "config.json")):
             model_cfg = model_config_from_hf(args.weights)
-        params = load_hf_params(args.weights, model_cfg)
-    dp, tp = (int(x) for x in args.mesh.split(","))
+        if dp * tp > 1:
+            # stream each checkpoint shard straight onto device shards —
+            # peak host memory stays at one tensor, not the whole model
+            import jax
+
+            from .engine import model as model_lib
+
+            mesh = model_lib.make_mesh((dp, tp), jax.devices())
+            params = load_hf_params_sharded(args.weights, model_cfg, mesh)
+        else:
+            params = load_hf_params(args.weights, model_cfg)
     eng_cfg = EngineConfig(
         num_blocks=args.num_blocks, block_size=args.block_size,
         max_model_len=min(args.max_model_len, model_cfg.max_position),

@@ -166,11 +166,22 @@ async def run_worker(args: argparse.Namespace) -> None:
     model_cfg = MODEL_PRESETS[args.model]()
     params = None
     if args.weights:
-        from .engine.weights import load_hf_params, model_config_from_hf
+        from .engine.weights import (
+            load_hf_params, load_hf_params_sharded, model_config_from_hf,
+        )
 
         if os.path.exists(os.path.join(args.weights, "config.json")):
             model_cfg = model_config_from_hf(args.weights)
-        params = load_hf_params(args.weights, model_cfg)
+        if dp * tp > 1 and args.pp <= 1:
+            # stream onto device shards (peak host memory = one tensor)
+            import jax
+
+            from .engine import model as model_lib
+
+            mesh = model_lib.make_mesh((dp, tp), jax.devices())
+            params = load_hf_params_sharded(args.weights, model_cfg, mesh)
+        else:
+            params = load_hf_params(args.weights, model_cfg)
         if args.tokenizer is None:
             args.tokenizer = args.weights
     eng_cfg = EngineConfig(

@@ -26,6 +26,11 @@
 #   scripts/verify.sh tune        # kernel tile autotune (CPU bitwise
 #                                 # parity sweep in a fusion-disabled
 #                                 # subprocess) + adaptive bucket ladders
+#   scripts/verify.sh mesh        # SpecLayout sharding parity: 1x8 / 2x4 /
+#                                 # 2x2x2 CPU meshes byte-identical to
+#                                 # single-device across decode, chunked
+#                                 # prefill, spec decode; sharded weights
+#                                 # streaming + orbax sharded restore
 set -u
 
 cd "$(dirname "$0")/.."
@@ -48,6 +53,18 @@ fi
 if [ "${1:-}" = "tune" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tune \
         -p no:cacheprovider
+fi
+
+if [ "${1:-}" = "mesh" ]; then
+    rc=0
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m mesh \
+        -p no:cacheprovider || rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "mesh parity FAILED; reproduce with:"
+        echo "  XLA_FLAGS=--xla_force_host_platform_device_count=8 \\"
+        echo "    JAX_PLATFORMS=cpu python -m pytest tests/ -m mesh"
+    fi
+    exit $rc
 fi
 
 if [ "${1:-}" = "obs" ]; then

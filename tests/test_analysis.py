@@ -515,6 +515,43 @@ def test_dt502_mesh_outside_layout_module():
     assert lint(src, path=LAYOUT, select=["DT502"]) == []
 
 
+def test_dt503_axis_carrying_partition_spec():
+    # any non-None argument counts as axis-carrying — even an imported
+    # constant (DT501 only catches the string-literal case)
+    src = """
+    from jax.sharding import PartitionSpec as P
+    from dynamo_tpu.parallel.layout import AXIS_TP
+
+    def shardings():
+        return P(None, AXIS_TP)
+    """
+    assert codes(lint(src, select=["DT503"])) == ["DT503"]
+
+
+def test_dt503_quiet_for_replicated_and_layout_module():
+    repl = """
+    from jax.sharding import PartitionSpec as P
+
+    A = P()
+    B = P(None, None)
+    """
+    assert lint(repl, select=["DT503"]) == []
+    carrying = """
+    from jax.sharding import PartitionSpec as P
+    SPEC = P(None, "tp")
+    """
+    assert lint(carrying, path=LAYOUT, select=["DT503"]) == []
+
+
+def test_dt503_suppression_comment():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    SPEC = P(None, "tp")  # dynalint: disable=DT503
+    """
+    assert lint(src, select=["DT503"]) == []
+
+
 # ---------------------------------------------------------------------------
 # baseline
 
