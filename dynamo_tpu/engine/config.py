@@ -191,6 +191,27 @@ class EngineConfig:
     # device token-history capacity per seat (0 = max_model_len); drafting
     # only sees the first spec_hist_cap positions of each sequence
     spec_hist_cap: int = 0
+    # engine stall watchdog: a dispatched window whose results don't land
+    # within stall_timeout_s + stall_timeout_per_token_s * real tokens is
+    # declared wedged — the window is cancelled, its shape class
+    # quarantined, and its seats recovered by recompute. 0 = watchdog off.
+    stall_timeout_s: float = 0.0
+    stall_timeout_per_token_s: float = 0.0
+    # per-seat recompute retries after a stall before the seat errors out
+    stall_seq_retries: int = 2
+    # consecutive stalled windows before the worker declares itself dead
+    # (aborts every seat so drain + Migration take over)
+    stall_dead_threshold: int = 3
+    # HBM-pressure ladder: graduated response to KV pool occupancy,
+    # engaged per rung when usage crosses its threshold (0.0 = rung off).
+    # rung 1: spill the coldest pending-free seat to the host pool (or
+    # plain recompute without kvbm); rung 2: pause speculative windows;
+    # rung 3: shed new admissions until pressure releases.
+    pressure_spill_threshold: float = 0.0
+    pressure_spec_threshold: float = 0.0
+    pressure_shed_threshold: float = 0.0
+    # hysteresis: a rung releases once usage < threshold - pressure_release
+    pressure_release: float = 0.05
 
     def __post_init__(self):
         if len(self.mesh_shape) not in (2, 3):
@@ -238,6 +259,20 @@ class EngineConfig:
                 raise ValueError("need 1 <= spec_ngram_min <= spec_ngram_max")
             if self.pp_stages > 1:
                 raise ValueError("spec_mode requires pp_stages == 1")
+        if self.stall_timeout_s < 0 or self.stall_timeout_per_token_s < 0:
+            raise ValueError("stall timeouts must be >= 0")
+        if self.stall_seq_retries < 0:
+            raise ValueError("stall_seq_retries must be >= 0")
+        if self.stall_dead_threshold < 1:
+            raise ValueError("stall_dead_threshold must be >= 1")
+        for rung in ("spill", "spec", "shed"):
+            v = getattr(self, f"pressure_{rung}_threshold")
+            if not (0.0 <= v <= 1.0):
+                raise ValueError(
+                    f"pressure_{rung}_threshold must be in [0, 1]"
+                )
+        if self.pressure_release < 0:
+            raise ValueError("pressure_release must be >= 0")
         # max_num_batched_tokens MAY exceed the largest prefill bucket:
         # the scheduler caps each chunk at the bucket, so extra budget
         # just lets decode seats coexist with a full-bucket prefill

@@ -33,6 +33,7 @@ from ..observability.flops import FlopsModel
 from ..observability.stepstats import (
     DECODE, PREFILL, SPEC_VERIFY, StepRecord, StepStats,
 )
+from ..runtime import faults
 from ..runtime.context import Context
 from ..runtime.engine import AsyncEngine
 from ..utils.config import env_flag, env_float, env_str
@@ -237,6 +238,26 @@ class EngineCore(AsyncEngine):
         # flight recorder (observability.StepStats) when enabled;
         # InferenceEngine builds it, the mocker leaves it None
         self.obs = None
+        # -- stall watchdog state (engine_config.stall_timeout_s > 0) --
+        # per-seq recovery attempts; a seq over stall_seq_retries is failed
+        # instead of requeued so one poisoned prompt can't loop forever
+        self._stall_retries: Dict[str, int] = {}
+        self._stall_streak = 0       # consecutive stalled landings
+        self.num_stalls = 0
+        self.stall_dead = False      # streak hit stall_dead_threshold
+        # quarantined (kind, bucket) shape classes: dispatch planning routes
+        # around them (next bucket up / einsum impl) after a stall
+        self._shape_quarantine: set = set()
+        self._window_seq = itertools.count(1)  # fault key for engine.stall
+        # -- HBM-pressure ladder state (pressure_*_threshold > 0) --
+        self.pressure_level = 0          # 0 idle .. 3 shedding
+        self.pressure_shedding = False   # rung 3: submit() rejects
+        self._pressure_spec_paused = False  # rung 2: spec decode paused
+        self._pressure_spec_saved = None    # spec_plan_window to restore
+        self._pressure_spill_cool = 0    # min ticks between rung-1 spills
+        self.num_pressure_spills = 0
+        self.num_pressure_shed = 0
+        self.pressure_peak = 0       # highest rung reached this lifetime
 
     # ------------------------- lifecycle -------------------------------
 
@@ -273,6 +294,22 @@ class EngineCore(AsyncEngine):
     async def submit(self, request: Request) -> AsyncIterator[StepOutput]:
         """Submit a request; yields StepOutputs as tokens are generated."""
         await self.start()
+        if self.pressure_shedding:
+            # the loop only ticks the ladder while seats are live; if the
+            # pool drained since the last pass, re-evaluate here so an idle
+            # engine doesn't shed forever on a stale flag
+            self._pressure_tick()
+        if self.pressure_shedding:
+            # rung 3 of the HBM-pressure ladder: refuse new admissions
+            # while resident seats drain; the router retries elsewhere
+            self.num_pressure_shed += 1
+            raise RuntimeError(
+                "admission shed: HBM pressure over pressure_shed_threshold"
+            )
+        if self.stall_dead:
+            raise RuntimeError(
+                "engine declared dead after repeated dispatch stalls"
+            )
         if not request.token_ids:
             raise ValueError("empty prompt")
         if len(request.token_ids) >= self.config.max_model_len:
@@ -598,6 +635,13 @@ class EngineCore(AsyncEngine):
         from ..observability import compilewatch
         snap = self.obs.snapshot()
         snap.update(compilewatch.snapshot())
+        snap["stalls_total"] = self.num_stalls
+        snap["stall_dead"] = int(self.stall_dead)
+        snap["stall_quarantined_shapes"] = len(self._shape_quarantine)
+        snap["pressure_level"] = self.pressure_level
+        snap["pressure_peak"] = self.pressure_peak
+        snap["pressure_spills_total"] = self.num_pressure_spills
+        snap["pressure_shed_total"] = self.num_pressure_shed
         # adaptive bucket ladders (InferenceEngine only): scalar gauges by
         # the exact keys observability.gauges reads; the rungs tuple is
         # non-scalar and stays off the wire dict
@@ -643,11 +687,26 @@ class EngineCore(AsyncEngine):
         async def land_next() -> None:
             batch0, fut = inflight.popleft()
             try:
-                results = await fut
+                results = await asyncio.wait_for(
+                    self._landing(batch0, fut), self._stall_deadline(batch0)
+                )
+            except asyncio.TimeoutError:
+                # the head landing blew its deadline: every younger window
+                # reads the wedged window's ring state, so the whole
+                # run-ahead pipeline is cancelled and recovered together
+                wedged = [batch0]
+                self._swallow_future(fut)
+                while inflight:
+                    b, f = inflight.popleft()
+                    wedged.append(b)
+                    self._swallow_future(f)
+                self._on_stall(wedged)
+                return
             except Exception:
                 log.exception("window failed; aborting its seqs")
                 self._abort_batch(batch0)
                 return
+            self._stall_streak = 0
             try:
                 self._postprocess(batch0, results)
             except Exception:
@@ -657,6 +716,7 @@ class EngineCore(AsyncEngine):
         while not self._stopped:
             while inflight and inflight[0][1].done():
                 await land_next()
+            self._pressure_tick()
             batch = self.scheduler.schedule()
             self._mark_preempted_seats(batch)
             if batch.is_empty:
@@ -682,6 +742,7 @@ class EngineCore(AsyncEngine):
                     break
                 await self._wake.wait()
                 continue
+            self._arm_stall_fault(batch)
             try:
                 fut = await self._dispatch_batch_async(batch)
             except Exception:
@@ -743,8 +804,261 @@ class EngineCore(AsyncEngine):
                 self._ap_mark_dead(seq.preempted_slot)
                 seq.preempted_slot = -1
 
+    # ----------------------- stall watchdog ----------------------------
+    # A wedged device dispatch (deadlocked collective, runaway recompile,
+    # driver hang) would otherwise freeze the loop forever: every queued
+    # request hangs and the worker looks alive to the router. The watchdog
+    # bounds each landing by a deadline scaled to the window's token count,
+    # cancels the wedged window, quarantines the shape class that wedged,
+    # and replays the touched seats from their journal (prompt + emitted
+    # tokens) — bounded retries per seat, bounded streak per worker.
+
+    def _stall_deadline(self, batch) -> Optional[float]:
+        """Deadline for one landing; None disables (stall_timeout_s <= 0).
+        Scales with scheduled work so big prefill windows aren't false
+        positives at the same setting that catches a wedged decode."""
+        base = self.config.stall_timeout_s
+        if base <= 0:
+            return None
+        n = sum(c.length for c in batch.prefills)
+        n += sum(r.accepted for r in batch.decode_rows)
+        return base + self.config.stall_timeout_per_token_s * n
+
+    def _arm_stall_fault(self, batch) -> None:
+        """Fault-registry seam: a ``delay`` rule on ``engine.stall`` wedges
+        this window's landing for delay_s, as a hung device dispatch would."""
+        rule = faults.active("engine.stall", str(next(self._window_seq)))
+        if rule is not None and rule.kind == faults.DELAY:
+            batch.stall_inject_s = rule.delay_s
+
+    async def _landing(self, batch, fut):
+        inject = getattr(batch, "stall_inject_s", 0.0)
+        if inject:
+            await asyncio.sleep(inject)  # seeded engine.stall wedge
+        return await fut
+
+    @staticmethod
+    def _swallow_future(fut) -> None:
+        """Detach from a wedged future: request cancellation and retrieve
+        any late exception so abandoned windows never log
+        'exception was never retrieved'."""
+        fut.cancel()
+        fut.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
+
+    def _shape_bucket(self, kind: str, n: int) -> int:
+        """Bucket used for stall attribution; the JAX engine maps through
+        its dispatch bucket ladders."""
+        return n
+
+    def _quarantine_shape(self, cls) -> None:
+        if cls not in self._shape_quarantine:
+            self._shape_quarantine.add(cls)
+            log.warning(
+                "stall watchdog: quarantined shape class %s:%s", *cls
+            )
+
+    def _batch_shape_classes(self, batch) -> set:
+        classes = set()
+        for chunk in batch.prefills:
+            classes.add(
+                ("prefill", self._shape_bucket("prefill", chunk.length))
+            )
+        if batch.decode_rows:
+            classes.add(
+                ("decode", self._shape_bucket("decode",
+                                              len(batch.decode_rows)))
+            )
+        return classes
+
+    def _on_stall(self, batches) -> None:
+        """A landing blew its deadline. Attribute the wedge to the head
+        window's shape classes (cross-checked against the compile watchdog's
+        last label in the log line), quarantine them, recover every touched
+        seat, and track the streak toward declaring the worker dead."""
+        self.num_stalls += 1
+        self._stall_streak += 1
+        classes = self._batch_shape_classes(batches[0])
+        label = ""
+        if self.obs is not None:
+            try:
+                from ..observability import compilewatch
+                snap = compilewatch.snapshot()
+                label = snap.get("last_compile_key", "") or ""
+            except Exception:
+                label = ""
+        log.error(
+            "dispatch stall: landing blew its deadline (shape classes %s, "
+            "last compile %r, streak %d/%d)",
+            sorted(classes), label, self._stall_streak,
+            self.config.stall_dead_threshold,
+        )
+        for cls in classes:
+            self._quarantine_shape(cls)
+        self._recover_batches(batches)
+        if self._stall_streak >= self.config.stall_dead_threshold:
+            self.stall_dead = True
+            log.error(
+                "stall streak hit %d — declaring worker dead",
+                self._stall_streak,
+            )
+            for seq in list(self._seqs.values()):
+                if seq.status != SeqStatus.FINISHED:
+                    self._ap_mark_dead(seq.slot)
+                    self.scheduler.abort(seq, "error")
+                    self._emit_finish(seq, "error")
+
+    def _recover_batches(self, batches) -> None:
+        """Cancel wedged windows: discard every pending they registered
+        (mirroring _abort_batch), then requeue each touched live seat for
+        journal replay — a recompute preemption whose 'journal' is the
+        seq's own prompt + emitted tokens, giving byte-identical resumption
+        under the seq's seed. Seats over stall_seq_retries fail instead."""
+        touched: Dict[str, SchedSeq] = {}
+        for batch in batches:
+            for chunk in batch.prefills:
+                self.scheduler.on_tokens_discarded(
+                    chunk.seq, 0, first=chunk.final, prompt=chunk.length
+                )
+                touched[chunk.seq.seq_id] = chunk.seq
+            for row in batch.decode_rows:
+                self.scheduler.on_tokens_discarded(row.seq, row.accepted)
+                touched[row.seq.seq_id] = row.seq
+        for seq in touched.values():
+            if seq.status == SeqStatus.FINISHED or seq.pending_total != 0:
+                continue
+            retries = self._stall_retries.get(seq.seq_id, 0) + 1
+            self._stall_retries[seq.seq_id] = retries
+            if retries > self.config.stall_seq_retries:
+                self._ap_mark_dead(seq.slot)
+                self.scheduler.abort(seq, "error")
+                self._emit_finish(seq, "error")
+                continue
+            if seq.status is SeqStatus.WAITING:
+                continue  # never held blocks — already queued for replay
+            slot = self.scheduler.preempt_recompute(seq)
+            self._ap_mark_dead(slot)
+
+    # ---------------------- HBM-pressure ladder ------------------------
+
+    def _pressure_tick(self) -> None:
+        """Graduated response to KV-pool pressure, one check per loop pass:
+        rung 1 spills the coldest seat (recompute preemption — its sealed
+        blocks stay evictable in the prefix cache / kvbm host tier), rung 2
+        pauses speculative decoding (frees draft lookahead), rung 3 sheds
+        new admissions. Rungs release with pressure_release hysteresis so
+        the ladder doesn't flap at a threshold."""
+        cfg = self.config
+        spill_t = cfg.pressure_spill_threshold
+        spec_t = cfg.pressure_spec_threshold
+        shed_t = cfg.pressure_shed_threshold
+        if spill_t <= 0 and spec_t <= 0 and shed_t <= 0:
+            return
+        usage = self.scheduler.pool.usage
+        release = cfg.pressure_release
+        if shed_t > 0:
+            if not self.pressure_shedding and usage >= shed_t:
+                self.pressure_shedding = True
+                log.warning(
+                    "pressure ladder: shedding admissions "
+                    "(pool usage %.2f >= %.2f)", usage, shed_t,
+                )
+            elif self.pressure_shedding and usage < shed_t - release:
+                self.pressure_shedding = False
+                log.info(
+                    "pressure ladder: admissions reopened (pool usage %.2f)",
+                    usage,
+                )
+        if spec_t > 0:
+            if not self._pressure_spec_paused and usage >= spec_t:
+                self._pressure_spec_paused = True
+                self._pause_spec()
+            elif self._pressure_spec_paused and usage < spec_t - release:
+                self._pressure_spec_paused = False
+                self._resume_spec()
+        if self._pressure_spill_cool > 0:
+            self._pressure_spill_cool -= 1
+        if (spill_t > 0 and usage >= spill_t
+                and self._pressure_spill_cool == 0):
+            victim = self.scheduler._pick_victim(None)
+            if victim is not None and victim.pending_total == 0:
+                slot = self.scheduler.preempt_recompute(victim)
+                self._ap_mark_dead(slot)
+                self.num_pressure_spills += 1
+                # cooldown bounds churn: the spilled seat re-prefills
+                # (mostly prefix hits) before another spill is considered
+                self._pressure_spill_cool = 4
+                log.info(
+                    "pressure ladder: spilled seq %s (pool usage %.2f)",
+                    victim.seq_id, usage,
+                )
+        self.pressure_level = (
+            3 if self.pressure_shedding
+            else 2 if self._pressure_spec_paused
+            else 1 if (spill_t > 0 and usage >= spill_t)
+            else 0
+        )
+        if self.pressure_level > self.pressure_peak:
+            self.pressure_peak = self.pressure_level
+
+    def _pause_spec(self) -> None:
+        """Rung 2 hook; the JAX engine narrows the spec plan window."""
+
+    def _resume_spec(self) -> None:
+        pass
+
+    # --------------------- preemption / evacuation ---------------------
+    # (runtime.preemption drives these: park a decoding seat, wait for its
+    #  inflight windows to land, stream its KV to a peer, finish it here)
+
+    def evacuable_seats(self) -> List[SchedSeq]:
+        """Decoding seats whose KV is worth moving (prefill complete).
+        PREFILL/WAITING seats are cheaper to re-prefill at the destination
+        than to stream mid-build."""
+        return [s for s in self.scheduler.running
+                if s.status is SeqStatus.RUNNING and s.prefill_done]
+
+    def park_for_evacuation(self, seq_id: str) -> Optional[SchedSeq]:
+        """Freeze a seat for KV evacuation: the scheduler plans no new
+        windows for it and never picks it as a recompute victim, so its
+        blocks stay byte-stable while the transfer reads them."""
+        seq = self._seqs.get(seq_id)
+        if seq is None or seq.status is not SeqStatus.RUNNING:
+            return None
+        seq.status = SeqStatus.EVACUATING
+        return seq
+
+    def unpark(self, seq: SchedSeq) -> None:
+        """Abort an evacuation: the seat resumes decoding locally."""
+        if seq.status is SeqStatus.EVACUATING:
+            seq.status = SeqStatus.RUNNING
+            self._wake.set()
+
+    async def wait_quiesced(
+        self, seq: SchedSeq, timeout_s: float = 10.0
+    ) -> bool:
+        """Wait until none of the seat's tokens are in an inflight window —
+        only then is its KV byte-stable and safe to read."""
+        deadline = time.monotonic() + timeout_s
+        while seq.pending_total > 0:
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.005)
+        return True
+
+    def finish_evacuated(self, seq: SchedSeq) -> None:
+        """The seat now lives on the receiving worker: kill the device seat
+        and close the local stream with finish_reason ``evacuated``."""
+        if seq.status is SeqStatus.FINISHED:
+            return
+        self._ap_mark_dead(seq.slot)
+        self.scheduler.abort(seq, "evacuated")
+        self._emit_finish(seq, "evacuated")
+
     async def _run_loop_sync(self) -> None:
         while not self._stopped:
+            self._pressure_tick()
             batch = self.scheduler.schedule()
             self._mark_preempted_seats(batch)
             if batch.is_empty:
@@ -771,8 +1085,16 @@ class EngineCore(AsyncEngine):
                     return
                 await self._wake.wait()
                 continue
+            self._arm_stall_fault(batch)
+            inner = asyncio.ensure_future(self._execute_batch_async(batch))
             try:
-                results = await self._execute_batch_async(batch)
+                results = await asyncio.wait_for(
+                    self._landing(batch, inner), self._stall_deadline(batch)
+                )
+            except asyncio.TimeoutError:
+                self._swallow_future(inner)
+                self._on_stall([batch])
+                continue
             except Exception:
                 log.exception("engine step failed; aborting scheduled seqs")
                 # _abort_batch also clears the speculative pendings that
@@ -780,6 +1102,7 @@ class EngineCore(AsyncEngine):
                 # as never-reaped zombies, leaking blocks and ring slots
                 self._abort_batch(batch)
                 continue
+            self._stall_streak = 0
             try:
                 self._postprocess(batch, results)
             except Exception:
@@ -1094,6 +1417,8 @@ class InferenceEngine(EngineCore):
                 engine_config.prefill_buckets,
             )
         self._rng = jax.random.PRNGKey(seed + 1)
+        # one-shot einsum rebuild when the largest decode bucket stalls
+        self._stall_einsum_fallback = False
         self._encode_fn = None  # built lazily on the first embed()
         self._mm_ring_fn = None  # lazy (pipelined mm prefill)
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -1473,15 +1798,81 @@ class InferenceEngine(EngineCore):
 
     def _bucket_for(self, kind: str, n: int) -> int:
         """Bucket ``n`` on the live ladder grid for ``kind`` (adaptive
-        rungs when the ladder is on, the static config grid otherwise)."""
+        rungs when the ladder is on, the static config grid otherwise).
+        Stall-quarantined buckets route to the next rung up — a different
+        compiled program doing the same work with padding."""
         lad = self._ladders.get(kind)
         if lad is not None:
-            return lad.bucket_for(n)
-        cfg = self.config
-        return _bucket(
-            n, cfg.decode_buckets if kind == "decode"
-            else cfg.prefill_buckets,
-        )
+            b = lad.bucket_for(n)
+            grid = tuple(sorted(lad.snapshot()["rungs"]))
+        else:
+            cfg = self.config
+            grid = (cfg.decode_buckets if kind == "decode"
+                    else cfg.prefill_buckets)
+            b = _bucket(n, grid)
+        if self._shape_quarantine and (kind, b) in self._shape_quarantine:
+            for g in grid:
+                if g >= b and (kind, g) not in self._shape_quarantine:
+                    return g
+        return b
+
+    def _shape_bucket(self, kind: str, n: int) -> int:
+        return self._bucket_for(kind, n)
+
+    def _quarantine_shape(self, cls) -> None:
+        """When the LARGEST decode bucket wedges there is no rung to route
+        to — rebuild the decode window on the einsum attention impl instead
+        (a different program for the same shape class), once."""
+        kind, bucket = cls
+        if (self.pp == 1 and kind == "decode"
+                and not self._stall_einsum_fallback):
+            cfg = self.config
+            grid = cfg.decode_buckets
+            lad = self._ladders.get("decode")
+            if lad is not None:
+                grid = tuple(sorted(lad.snapshot()["rungs"]))
+            if bucket >= max(grid):
+                try:
+                    import dataclasses as _dc
+                    fb_cfg = _dc.replace(
+                        cfg, attention_impl_decode="einsum"
+                    )
+                    self._ap_window_fn, self._ap_delta_fn = (
+                        model_lib.make_autopilot_fns(
+                            self.model_config, fb_cfg, self._window_K,
+                            self._ap_Wcap, self.mesh,
+                        )
+                    )
+                    self._stall_einsum_fallback = True
+                    log.warning(
+                        "stall watchdog: decode:%d is the largest rung — "
+                        "rebuilt the decode window on the einsum attention "
+                        "impl instead of quarantining it", bucket,
+                    )
+                    return
+                except Exception:
+                    log.exception(
+                        "einsum fallback rebuild failed — quarantining "
+                        "decode:%d (it will keep dispatching at its own "
+                        "rung)", bucket,
+                    )
+        super()._quarantine_shape(cls)
+
+    def _pause_spec(self) -> None:
+        # pp engines never set _spec_k (spec decode is single-engine only)
+        if getattr(self, "_spec_k", 0) <= 0 or self._spec_auto_disabled:
+            return
+        self._pressure_spec_saved = self.scheduler.spec_plan_window
+        self.scheduler.spec_plan_window = None
+        log.warning("pressure ladder: speculative decoding paused")
+
+    def _resume_spec(self) -> None:
+        if self._pressure_spec_saved is None:
+            return
+        if not self._spec_auto_disabled:
+            self.scheduler.spec_plan_window = self._pressure_spec_saved
+        self._pressure_spec_saved = None
+        log.info("pressure ladder: speculative decoding resumed")
 
     def _prefill_arrays(self, chunk: PrefillChunk, use_sp: bool):
         cfg = self.config
@@ -1756,7 +2147,8 @@ class InferenceEngine(EngineCore):
         return samples, list(self._ap_cols), spec
 
     def _spec_active(self) -> bool:
-        return self._spec_k > 0 and not self._spec_auto_disabled
+        return (self._spec_k > 0 and not self._spec_auto_disabled
+                and not self._pressure_spec_paused)
 
     def _spec_fill_hist(self, rows) -> None:
         """Inject full token histories for joining/reset seats so the

@@ -188,6 +188,10 @@ class SeqStatus(enum.Enum):
     WAITING = "waiting"
     PREFILL = "prefill"
     RUNNING = "running"
+    # parked for live KV evacuation (runtime/preemption.py): no new
+    # windows are planned for the seat, its blocks stay pinned until the
+    # transfer lands, and it is not a recompute-preemption victim
+    EVACUATING = "evacuating"
     FINISHED = "finished"
 
 
@@ -685,20 +689,30 @@ class Scheduler:
     def _pick_victim(self, requester: SchedSeq) -> Optional[SchedSeq]:
         # LIFO, but a seq with in-flight windows is unpreemptible: freeing
         # its blocks while a dispatched window scatters into them corrupts
-        # whichever seq the pool hands them to next
+        # whichever seq the pool hands them to next. EVACUATING seats are
+        # likewise pinned: a transfer is reading their blocks.
         for cand in reversed(self.running):
             if cand is requester:
+                continue
+            if cand.status is not SeqStatus.RUNNING:
                 continue
             if cand.pending_total == 0:
                 return cand
         return None
 
-    def _preempt(self, seq: SchedSeq, batch: ScheduledBatch) -> None:
+    def preempt_recompute(self, seq: SchedSeq) -> int:
+        """Preempt a quiesced seq back to the waiting queue: release its
+        blocks and slot, reset computed state so admission re-prefills the
+        full token history (prompt + outputs, byte-identical continuation).
+        Returns the autopilot slot the seq held — the engine must mark it
+        dead before the blocks recycle. Public entry for the stall
+        watchdog and the HBM-pressure ladder."""
         assert seq.pending_total == 0, "preempting a seq with inflight work"
         log.info("preempting seq %s (recompute)", seq.seq_id)
         # the engine must kill the device autopilot seat before these
-        # blocks recycle — batch.preempted carries the slot it held
+        # blocks recycle — preempted_slot carries the slot it held
         seq.preempted_slot = seq.slot
+        slot = seq.slot
         self._release_blocks(seq)
         self._free_slot(seq)
         seq.num_computed = 0
@@ -707,7 +721,14 @@ class Scheduler:
         seq.status = SeqStatus.WAITING
         if seq in self.running:
             self.running.remove(seq)
-        self.waiting.appendleft(seq)
+        # a mid-prefill seq (non-final chunk) never left the waiting deque;
+        # re-adding it would double-schedule the prompt
+        if seq not in self.waiting:
+            self.waiting.appendleft(seq)
+        return slot
+
+    def _preempt(self, seq: SchedSeq, batch: ScheduledBatch) -> None:
+        self.preempt_recompute(seq)
         batch.preempted.append(seq)
 
     def _release_blocks(self, seq: SchedSeq) -> None:

@@ -12,13 +12,12 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import signal
-
 from ..llm.discovery import ModelDeploymentCard, ModelWatcher
 from ..llm.entrypoint import (
     EmbeddingsPipeline, build_routed_pipeline, make_kv_sink,
 )
 from ..runtime.component import DistributedRuntime
+from ..runtime.signals import install_shutdown_signals
 from ..runtime.tasks import spawn_logged
 from ..utils.config import RuntimeConfig
 from ..utils.logging import get_logger
@@ -231,11 +230,10 @@ async def run_frontend(args: argparse.Namespace) -> None:
     )
     degradation_watcher.start()
 
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(
-            sig, lambda: spawn_logged(_shutdown(), name="frontend-shutdown")
-        )
+    install_shutdown_signals(
+        lambda: spawn_logged(_shutdown(), name="frontend-shutdown"),
+        loop=asyncio.get_running_loop(), name="frontend",
+    )
 
     async def _shutdown():
         if stats_task is not None:

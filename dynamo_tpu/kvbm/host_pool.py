@@ -26,6 +26,7 @@ log = get_logger("kvbm.host_pool")
 @dataclass
 class HostPoolStats:
     g2_blocks: int = 0
+    g2_bytes: int = 0
     g3_blocks: int = 0
     g2_hits: int = 0
     g3_hits: int = 0
@@ -47,6 +48,7 @@ class HostBlockPool:
         if self.disk_dir is not None:
             self.disk_dir.mkdir(parents=True, exist_ok=True)
         self._mem: "OrderedDict[int, Dict[str, np.ndarray]]" = OrderedDict()
+        self._mem_bytes = 0  # incremental: a per-put sum over G2 is O(n)
         self._disk: "OrderedDict[int, Path]" = OrderedDict()
         self.stats = HostPoolStats()
         # called with a seq_hash that left the pool entirely (distributed
@@ -94,8 +96,10 @@ class HostBlockPool:
             self._mem.move_to_end(seq_hash)
             return
         self._mem[seq_hash] = data
+        self._mem_bytes += sum(a.nbytes for a in data.values())
         while len(self._mem) > self.capacity:
             old_hash, old_data = self._mem.popitem(last=False)
+            self._mem_bytes -= sum(a.nbytes for a in old_data.values())
             self._spill(old_hash, old_data)
         self._refresh()
 
@@ -135,4 +139,5 @@ class HostBlockPool:
 
     def _refresh(self) -> None:
         self.stats.g2_blocks = len(self._mem)
+        self.stats.g2_bytes = self._mem_bytes
         self.stats.g3_blocks = len(self._disk)

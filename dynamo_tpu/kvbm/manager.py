@@ -111,6 +111,19 @@ class KvbmManager:
         self._pending: Dict[int, _Pending] = {}
         self.block_size = engine.config.block_size
 
+    def snapshot(self) -> Dict[str, float]:
+        """Scalar wire dict for the worker metrics publisher (the
+        aggregator re-exports these as ``kvbm_*`` gauges)."""
+        hs = self.host_pool.stats
+        return {
+            "host_pool_blocks": hs.g2_blocks + hs.g3_blocks,
+            "host_pool_bytes": hs.g2_bytes,
+            "spills_total": hs.spills,
+            "drops_total": hs.drops,
+            "offloaded_total": self.stats.offloaded_blocks,
+            "onboarded_total": self.stats.onboarded_blocks,
+        }
+
     # ---- pool event hook (called synchronously from the scheduler) ----
 
     def on_pool_event(self, event) -> None:

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import signal
 import time
 from typing import Callable, Dict
 
@@ -22,6 +21,7 @@ import msgpack
 from .planner.connector import planner_events_subject
 from .router.kv_router import KV_EVENTS_SUBJECT, LOAD_METRICS_SUBJECT
 from .runtime.component import DistributedRuntime
+from .runtime.signals import install_shutdown_signals
 from .runtime.system_server import SystemServer
 from .runtime.tasks import spawn_logged
 from .utils.config import RuntimeConfig
@@ -102,6 +102,27 @@ class MetricsAggregator:
         self._g_dg_orphans = m.gauge(
             "disagg_orphans_reaped_total",
             "per-worker deadline-expired handoff entries reaped", ["worker"]
+        )
+        # kvbm host-tier health ("kvbm" key of the snapshot): resident
+        # bytes and spill pressure of each worker's G2/G3 pools
+        self._g_kvbm_bytes = m.gauge(
+            "kvbm_host_pool_bytes",
+            "per-worker bytes resident in the kvbm host (G2) pool",
+            ["worker"]
+        )
+        self._g_kvbm_spills = m.gauge(
+            "kvbm_spills_total",
+            "per-worker G2→G3 disk spills", ["worker"]
+        )
+        # preemption tolerance ("preempt" key): maintenance notices seen
+        # and where the evacuated seats went
+        self._g_preempt_notices = m.gauge(
+            "worker_preempt_notices",
+            "per-worker maintenance notices received", ["worker"]
+        )
+        self._g_preempt_evacuated = m.gauge(
+            "worker_preempt_evacuated_total",
+            "per-worker seats evacuated to a peer", ["worker"]
         )
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
@@ -203,6 +224,18 @@ class MetricsAggregator:
             dg.get("transfer_retries_total", 0.0))
         self._g_dg_orphans.labels(worker=wid).set(
             dg.get("orphans_reaped_total", 0.0))
+        # forward-compat: workers without an attached kvbm publish no
+        # "kvbm", pre-preemption workers no "preempt" — zero-default both
+        kb = snap.get("kvbm") or {}
+        self._g_kvbm_bytes.labels(worker=wid).set(
+            kb.get("host_pool_bytes", 0.0))
+        self._g_kvbm_spills.labels(worker=wid).set(
+            kb.get("spills_total", 0.0))
+        pe = snap.get("preempt") or {}
+        self._g_preempt_notices.labels(worker=wid).set(
+            pe.get("notices", 0.0))
+        self._g_preempt_evacuated.labels(worker=wid).set(
+            pe.get("evacuated_total", 0.0))
         self.expire_stale()
         self._recompute_hit_rate()
         self._recompute_spec_rate()
@@ -220,7 +253,9 @@ class MetricsAggregator:
                           self._g_spec_accept, self._g_mfu, self._g_goodput,
                           self._g_pad_waste, self._g_dg_fallbacks,
                           self._g_dg_breaker, self._g_dg_retries,
-                          self._g_dg_orphans):
+                          self._g_dg_orphans, self._g_kvbm_bytes,
+                          self._g_kvbm_spills, self._g_preempt_notices,
+                          self._g_preempt_evacuated):
                 gauge.remove(worker=wid)
             log.info("expired stale worker %s from the scrape", wid)
 
@@ -257,6 +292,15 @@ class MetricsAggregator:
                 if role in event:
                     self._g_targets.labels(role=role).set(event[role])
             self._c_transitions.labels(kind="scale", detail="targets").inc()
+        elif kind == "preemption":
+            # a worker announced a maintenance notice (or the planner
+            # echoed one): count it so dashboards line the evacuation up
+            # against the scale response
+            self._c_transitions.labels(
+                kind="preemption",
+                detail=str(event.get("worker") or event.get("notices")
+                           or "notice"),
+            ).inc()
 
     def queue_depth(self) -> int:
         """Requests waiting across every live worker (the planner's
@@ -270,6 +314,12 @@ class MetricsAggregator:
         accepted = sum((s.get("spec") or {}).get("accepted", 0)
                        for s in self.worker_stats.values())
         return accepted / drafted if drafted else None
+
+    def preempt_notices(self) -> int:
+        """Maintenance notices across live workers (the planner treats a
+        noticed worker as capacity already on its way out)."""
+        return int(sum((s.get("preempt") or {}).get("notices", 0)
+                       for s in self.worker_stats.values()))
 
     def _obs_mean(self, key: str):
         """Mean of a flight-recorder field over workers that publish it
@@ -297,6 +347,7 @@ class MetricsAggregator:
             payload = {
                 "queue_depth": self.queue_depth(),
                 "spec_acceptance": self.spec_acceptance(),
+                "preempt_notices": self.preempt_notices(),
                 "num_workers": len(self.worker_stats),
                 # flight-recorder aggregates (None with no recorder-bearing
                 # workers): fleet-mean utilization/waste + summed goodput
@@ -343,17 +394,15 @@ async def run(args: argparse.Namespace) -> None:
                           port=args.port)
     await server.start()
 
-    loop = asyncio.get_running_loop()
-
     async def _shutdown():
         await agg.stop()
         await server.stop()
         await runtime.shutdown()
 
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(
-            sig, lambda: spawn_logged(_shutdown(), name="aggregator-shutdown")
-        )
+    install_shutdown_signals(
+        lambda: spawn_logged(_shutdown(), name="aggregator-shutdown"),
+        loop=asyncio.get_running_loop(), name="aggregator",
+    )
     log.info("metrics aggregator on %s:%d (component=%s)",
              args.host, server.port, args.component)
     await runtime.shutdown_event.wait()

@@ -1081,3 +1081,414 @@ async def run_disagg_scenario(sc: DisaggChaosScenario) -> dict:
         return await h.run()
     finally:
         await h.stop()
+
+
+# --------------------- preemption chaos harness --------------------------
+# Real tiny InferenceEngines driven through seeded preemption storms: a
+# maintenance notice lands mid-decode and every in-flight seat must end up
+# byte-identical to an unfaulted reference — continued on a peer after a
+# device-plane KV hand-off, resumed from the host spill tier, or replayed
+# Migration-style from the seat journal. The same harness drives the engine
+# stall watchdog (a wedged dispatch window must recover, not hang) and the
+# HBM-pressure ladder (spill/pause/shed must engage and release without
+# leaking a block).
+
+
+@dataclass
+class PreemptionChaosScenario:
+    """One seeded preemption storm. ``mode`` picks the failure shape:
+
+    - ``notice-then-kill``   notice → evacuate to a peer → kill the source
+    - ``notice-no-peer``     notice with no peer: spill to the host tier,
+                             resume from kvbm prefix hits
+    - ``kill-no-notice``     the notice is LOST (fault drop): seats die
+                             cold and recovery is Migration-style replay
+    - ``stall-mid-window``   a dispatch window wedges on device; the stall
+                             watchdog must recover it within the deadline
+    - ``pressure-waves``     an undersized pool forces the HBM-pressure
+                             ladder through spill → shed and back
+    """
+
+    name: str
+    mode: str
+    seed: int = 0
+    num_requests: int = 4
+    concurrency: int = 4
+    prompt_len: Tuple[int, int] = (24, 40)
+    # enough decode runway that seats are still mid-flight when the grace
+    # window closes — CPU decode is fast, short budgets drain during it
+    max_tokens: int = 20
+    # fire the notice once every live request has emitted this many tokens
+    notice_after_tokens: int = 2
+    # zero grace keeps the storm deterministic: a warmed CPU engine drains
+    # any realistic token budget inside a timed grace window, leaving
+    # nothing to evacuate (the grace sleep itself has no failure modes)
+    notice_grace_s: float = 0.0
+    evac_deadline_s: float = 10.0
+    # stall-mid-window: watchdog deadline + injected wedge length
+    stall_timeout_s: float = 0.4
+    stall_delay_s: float = 2.0
+    stall_after_windows: int = 3
+    # pressure-waves: pool size + ladder thresholds
+    pressure_num_blocks: int = 40
+    pressure_spill_threshold: float = 0.6
+    pressure_shed_threshold: float = 0.85
+    plan_fn: Optional[object] = None   # Callable[[FaultPlan], None]
+
+
+class PreemptionChaosHarness:
+    """Builds the source/peer/reference engine trio, plants the canary on
+    the receiver, runs the storm, accounts for every block. Use
+    :func:`run_preemption_scenario` for the one-shot form."""
+
+    def __init__(self, sc: PreemptionChaosScenario):
+        self.sc = sc
+        self._canary_seq = None
+        self._canary_pattern = None
+        self._free_baseline: Dict[str, int] = {}
+
+    # ------------------------------ setup -------------------------------
+
+    async def start(self) -> None:
+        from ..engine.config import EngineConfig, ModelConfig
+        from ..engine.engine import InferenceEngine
+        from ..kvbm.manager import KvbmConfig
+        from ..runtime.preemption import PreemptionCoordinator
+
+        sc = self.sc
+        model_cfg = ModelConfig.tiny(vocab_size=256)
+        kwargs: dict = {}
+        num_blocks = 64
+        if sc.mode == "stall-mid-window":
+            # two decode rungs so quarantine can route 4-row windows to
+            # the 8-row bucket instead of rebuilding with einsum attention
+            kwargs = {"stall_timeout_s": sc.stall_timeout_s,
+                      "stall_seq_retries": 4, "stall_dead_threshold": 10}
+        elif sc.mode == "pressure-waves":
+            num_blocks = sc.pressure_num_blocks
+            kwargs = {
+                "pressure_spill_threshold": sc.pressure_spill_threshold,
+                "pressure_shed_threshold": sc.pressure_shed_threshold,
+                "pressure_release": 0.1,
+            }
+        eng_cfg = EngineConfig(
+            num_blocks=num_blocks, block_size=4, max_model_len=128,
+            max_num_batched_tokens=128, prefill_buckets=(128,),
+            decode_buckets=(4, 8), max_num_seqs=4, **kwargs,
+        )
+        ref_cfg = EngineConfig(
+            num_blocks=64, block_size=4, max_model_len=128,
+            max_num_batched_tokens=128, prefill_buckets=(128,),
+            decode_buckets=(4, 8), max_num_seqs=4,
+        )
+        # identical init seeds: evacuated continuations, spill resumes, and
+        # the serial reference must all be greedy-identical
+        self.src = InferenceEngine(model_cfg, eng_cfg, seed=0)
+        self.peer = InferenceEngine(model_cfg, ref_cfg, seed=0)
+        self.reference = InferenceEngine(model_cfg, ref_cfg, seed=0)
+        if sc.mode == "notice-no-peer":
+            # the spill tier: src evacuates into its host pool; the resume
+            # worker onboards from the SAME pool (a shared host tier, as
+            # the store remote tier would be in production)
+            self.src.attach_kvbm(KvbmConfig(host_blocks=256))
+            self.peer.attach_kvbm(KvbmConfig(host_blocks=256))
+            self.peer.kvbm.host_pool = self.src.kvbm.host_pool
+        self.coordinator = PreemptionCoordinator(
+            self.src,
+            worker_key=f"chaos-{sc.seed}",
+            peer=self.peer if sc.mode == "notice-then-kill" else None,
+            notice_grace_s=sc.notice_grace_s,
+            evac_deadline_s=sc.evac_deadline_s,
+        )
+        await self._plant_canary()
+        self._free_baseline = {
+            "src": self.src.scheduler.pool.num_free,
+            "peer": self.peer.scheduler.pool.num_free,
+        }
+
+    async def stop(self) -> None:
+        for engine in (self.src, self.peer, self.reference):
+            await engine.stop()
+
+    async def _plant_canary(self) -> None:
+        import numpy as np
+
+        from ..engine.engine import Request
+
+        req = Request(request_id="canary", token_ids=list(range(1, 18)),
+                      max_tokens=1)
+        seq = self.peer.reserve_sequence(req)
+        assert seq is not None, "canary reservation must fit"
+        probe = await self.peer.extract_kv_blocks(seq.block_table)
+        self._canary_pattern = {
+            "k": np.full(probe["k"].shape, 3.0, probe["k"].dtype),
+            "v": np.full(probe["v"].shape, -5.0, probe["v"].dtype),
+        }
+        await self.peer.inject_kv_blocks(seq.block_table,
+                                         self._canary_pattern)
+        self._canary_seq = seq
+
+    async def _canary_corrupted(self) -> bool:
+        import numpy as np
+
+        got = await self.peer.extract_kv_blocks(self._canary_seq.block_table)
+        ok = (np.array_equal(np.asarray(got["k"], np.float32),
+                             np.asarray(self._canary_pattern["k"],
+                                        np.float32))
+              and np.array_equal(
+                  np.asarray(got["v"], np.float32),
+                  np.asarray(self._canary_pattern["v"], np.float32)))
+        return not ok
+
+    # ---------------------------- collectors ----------------------------
+
+    @staticmethod
+    async def _collect_wire(stream) -> Tuple[List[int], Optional[str]]:
+        """Tokens + final finish_reason from a wire-dict stream. Keyed by
+        index: an abort/evacuation finish frame re-carries the last token,
+        which must not be double-counted."""
+        toks: Dict[int, int] = {}
+        reason = None
+        async for out in stream:
+            for t in out["token_ids"]:
+                if t >= 0:
+                    toks[out["index"]] = t
+            if out.get("finished"):
+                reason = out.get("finish_reason")
+        return [toks[i] for i in sorted(toks)], reason
+
+    @staticmethod
+    async def _collect_outputs(aiter) -> Tuple[List[int], Optional[str]]:
+        """Same, for a StepOutput stream (submit / resume_prefilled)."""
+        toks: Dict[int, int] = {}
+        reason = None
+        async for out in aiter:
+            if out.token_id >= 0:
+                toks[out.index] = out.token_id
+            if out.finished:
+                reason = out.finish_reason
+                break
+        return [toks[i] for i in sorted(toks)], reason
+
+    # ----------------------------- the storm ----------------------------
+
+    async def run(self) -> dict:
+        from ..runtime import faults
+        from ..runtime.faults import FaultPlan
+
+        sc = self.sc
+        rng = random.Random(sc.seed)
+        prompts = [
+            [rng.randrange(1, 255)
+             for _ in range(rng.randint(*sc.prompt_len))]
+            for _ in range(sc.num_requests)
+        ]
+        requests = [
+            {"token_ids": p, "max_tokens": sc.max_tokens,
+             "ignore_eos": True}
+            for p in prompts
+        ]
+        # serial greedy reference BEFORE any fault is installed
+        expected = []
+        for r in requests:
+            toks, _ = await self._collect_wire(
+                self.reference.generate(dict(r), Context())
+            )
+            expected.append(toks)
+
+        plan = FaultPlan(seed=sc.seed)
+        if sc.plan_fn is not None:
+            sc.plan_fn(plan)
+        if sc.mode == "kill-no-notice":
+            plan.drop_connection("preempt.notice")
+        if sc.mode == "stall-mid-window":
+            plan.delay("engine.stall", sc.stall_delay_s,
+                       after=sc.stall_after_windows, times=1)
+        faults.install(plan)
+
+        progress = [0] * sc.num_requests
+        results: List[Optional[List[int]]] = [None] * sc.num_requests
+        reasons: List[Optional[str]] = [None] * sc.num_requests
+        sem = asyncio.Semaphore(sc.concurrency)
+
+        async def _one(i: int) -> None:
+            async with sem:
+                await asyncio.sleep(rng.random() * 0.02)
+                ctx = Context(request_id=f"preempt{sc.seed}-{i}")
+                for attempt in range(40):
+                    try:
+                        toks: Dict[int, int] = {}
+                        reason = None
+                        async for out in self.src.generate(
+                            dict(requests[i]), ctx
+                        ):
+                            for t in out["token_ids"]:
+                                if t >= 0:
+                                    toks[out["index"]] = t
+                            progress[i] = len(toks)
+                            if out.get("finished"):
+                                reason = out.get("finish_reason")
+                        results[i] = [toks[k] for k in sorted(toks)]
+                        reasons[i] = reason
+                        return
+                    except RuntimeError as exc:
+                        # admission shed (pressure rung 3): back off and
+                        # retry, exactly what the router would do
+                        if "shed" not in str(exc):
+                            raise
+                        await asyncio.sleep(0.05)
+                raise AssertionError(f"request {i} shed forever")
+
+        report = None
+
+        async def _notice_when_decoding() -> None:
+            nonlocal report
+            while not all(p >= sc.notice_after_tokens or r is not None
+                          for p, r in zip(progress, results)):
+                await asyncio.sleep(0.005)
+            report = await self.coordinator.notice("chaos")
+            if sc.mode == "kill-no-notice":
+                # the notice was dropped: the kill lands on live seats
+                for seq in list(self.src.scheduler.running):
+                    self.src.abort(seq.seq_id, "error")
+
+        noticer = None
+        if sc.mode in ("notice-then-kill", "notice-no-peer",
+                       "kill-no-notice"):
+            noticer = asyncio.create_task(_notice_when_decoding())
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(_one(i) for i in range(sc.num_requests))),
+                timeout=120.0,
+            )
+            if noticer is not None:
+                await asyncio.wait_for(noticer, timeout=30.0)
+        finally:
+            faults.clear()
+            if noticer is not None and not noticer.done():
+                noticer.cancel()
+                await asyncio.gather(noticer, return_exceptions=True)
+
+        # ----- resume every interrupted seat and splice the tails -----
+        by_seat = {}
+        if report is not None:
+            by_seat = {r.record.seq_id: r for r in report.results}
+        spliced: List[Optional[List[int]]] = []
+        for i in range(sc.num_requests):
+            got, reason = results[i], reasons[i]
+            if got is None:
+                spliced.append(None)
+                continue
+            if reason in ("length", "stop"):
+                spliced.append(got)        # finished before the storm hit
+                continue
+            rid = f"preempt{sc.seed}-{i}"
+            res = by_seat.get(rid)
+            if res is not None and res.mode == "peer":
+                # receiver re-emits the frontier token as index 0; the
+                # source already delivered it
+                tail, _ = await self._collect_outputs(
+                    self.peer.resume_prefilled(
+                        res.dst_seq, res.record.first_token())
+                )
+                spliced.append(got + tail[1:])
+            elif res is not None and res.mode in ("spill", "fallback"):
+                req = res.record.resume_request()
+                tail, _ = await self._collect_outputs(
+                    await self._submit(self.peer, req))
+                spliced.append(got + tail)
+            elif reason == "error" and sc.mode == "kill-no-notice":
+                # Migration-style replay from client state: full history
+                # as prompt, budget shrunk by what was delivered
+                from ..engine.engine import Request
+
+                req = Request(
+                    request_id=rid, token_ids=list(prompts[i]) + got,
+                    max_tokens=max(1, sc.max_tokens - len(got)),
+                    ignore_eos=True,
+                )
+                tail, _ = await self._collect_outputs(
+                    await self._submit(self.peer, req))
+                spliced.append(got + tail)
+            else:
+                spliced.append(got)
+
+        # quiesce: all seats finished, pools back to baseline
+        for _ in range(50):
+            if (not self.src.scheduler.running
+                    and not self.src.scheduler.waiting
+                    and not self.peer.scheduler.running
+                    and (self.src.scheduler.pool.num_free
+                         == self._free_baseline["src"])
+                    and (self.peer.scheduler.pool.num_free
+                         == self._free_baseline["peer"])):
+                break
+            await asyncio.sleep(0.2)
+
+        parity_failures = sum(
+            1 for got, want in zip(spliced, expected) if got != want
+        )
+        completed = sum(1 for got in spliced if got is not None)
+        leaked_src = (self._free_baseline["src"]
+                      - self.src.scheduler.pool.num_free)
+        leaked_peer = (self._free_baseline["peer"]
+                       - self.peer.scheduler.pool.num_free)
+        # the canary is the only reservation allowed to survive the storm
+        leaked_reservations = (
+            len(self.src._kv_reservations)
+            + len(self.peer._kv_reservations)
+            - (1 if self._canary_seq is not None else 0)
+        )
+        leaked_pending = sum(
+            s.pending_total for s in self.src.scheduler.running
+        )
+        canary_corrupted = await self._canary_corrupted()
+        self.peer.cancel_reservation(self._canary_seq)
+        out = {
+            "name": sc.name,
+            "mode": sc.mode,
+            "seed": sc.seed,
+            "num_requests": sc.num_requests,
+            "completed": completed,
+            "parity_failures": parity_failures,
+            "notices": self.coordinator.num_notices,
+            "evacuated_peer": self.coordinator.num_evacuated,
+            "spilled": self.coordinator.num_spilled,
+            "fallbacks": self.coordinator.num_fallbacks,
+            "journal_len": len(self.coordinator.journal),
+            "notice_lost": bool(report.notice_lost) if report else False,
+            "deadline_blown": (bool(report.deadline_blown)
+                               if report else False),
+            "stalls": self.src.num_stalls,
+            "stall_dead": self.src.stall_dead,
+            "quarantined_shapes": len(self.src._shape_quarantine),
+            "pressure_spills": self.src.num_pressure_spills,
+            "pressure_shed": self.src.num_pressure_shed,
+            "pressure_level": self.src.pressure_level,
+            "pressure_peak": self.src.pressure_peak,
+            "onboarded_blocks": (
+                self.peer.kvbm.stats.onboarded_blocks
+                if self.peer.kvbm is not None else 0),
+            "faults_fired": plan.fired(),
+            "canary_corrupted": canary_corrupted,
+            "leaked_blocks": leaked_src + leaked_peer,
+            "leaked_blocks_src": leaked_src,
+            "leaked_blocks_peer": leaked_peer,
+            "leaked_pending": leaked_pending,
+            "leaked_reservations": leaked_reservations,
+        }
+        return out
+
+    @staticmethod
+    async def _submit(engine, req):
+        return engine.submit(req)
+
+
+async def run_preemption_scenario(sc: PreemptionChaosScenario) -> dict:
+    """One-shot: build the harness, run the storm, tear everything down."""
+    h = PreemptionChaosHarness(sc)
+    await h.start()
+    try:
+        return await h.run()
+    finally:
+        await h.stop()

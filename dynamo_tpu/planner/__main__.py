@@ -151,7 +151,8 @@ async def run_planner(args: argparse.Namespace) -> None:
 
     ns = runtime.namespace().name
     # latest aggregator-published signals, merged into each frontend window
-    signals = {"queue_depth": 0, "spec_acceptance": None}
+    signals = {"queue_depth": 0, "spec_acceptance": None,
+               "preempt_notices": 0}
 
     def _window_from(win: dict) -> WindowMetrics:
         return WindowMetrics(
@@ -171,6 +172,7 @@ async def run_planner(args: argparse.Namespace) -> None:
             spec_acceptance=(win.get("spec_acceptance")
                              if win.get("spec_acceptance") is not None
                              else signals["spec_acceptance"]),
+            preempt_notices=signals["preempt_notices"] or 0,
         )
 
     async def _subscribe_loop(subject, handler):
@@ -201,6 +203,7 @@ async def run_planner(args: argparse.Namespace) -> None:
     def _on_signals(payload: dict) -> None:
         signals["queue_depth"] = payload.get("queue_depth") or 0
         signals["spec_acceptance"] = payload.get("spec_acceptance")
+        signals["preempt_notices"] = payload.get("preempt_notices") or 0
 
     tasks = [
         asyncio.create_task(

@@ -55,6 +55,9 @@ class WindowMetrics:
     queue_depth: float = 0.0
     breaker_open: int = 0
     spec_acceptance: Optional[float] = None
+    # workers that received a maintenance notice this window: capacity that
+    # is evacuating and about to vanish (runtime.preemption)
+    preempt_notices: int = 0
 
     @property
     def is_valid(self) -> bool:
@@ -96,6 +99,10 @@ class PlannerConfig:
     # add one decode replica per open breaker: a tripped worker serves
     # nothing, so intent must cover the hole until it heals
     compensate_breakers: bool = True
+    # add one decode replica per maintenance-noticed worker: its seats are
+    # evacuating and the node is leaving — scale the replacement proactively
+    # instead of waiting for the capacity hole to show up in latency
+    compensate_preemptions: bool = True
     # graceful degradation before scaling; None disables the ladder
     degradation: Optional[DegradationConfig] = field(
         default_factory=DegradationConfig
@@ -209,6 +216,8 @@ class Planner:
                 num_p = math.ceil(num_p * min(boost, 4.0))
             if cfg.compensate_breakers and m.breaker_open > 0:
                 num_d += int(m.breaker_open)
+            if cfg.compensate_preemptions and m.preempt_notices > 0:
+                num_d += int(m.preempt_notices)
 
         num_p = max(num_p, cfg.min_endpoint)
         num_d = max(num_d, cfg.min_endpoint)
@@ -252,6 +261,14 @@ class Planner:
         window and emit replica targets. Returns (num_p, num_d) or None when
         there is no traffic history yet."""
         await self._order_degradation()
+        m = self.last_window
+        if (m is not None and m.preempt_notices > 0
+                and hasattr(self.connector, "publish_event")):
+            # surface the proactive-scale trigger so dashboards can line the
+            # evacuation up against the replica response
+            await self.connector.publish_event({
+                "kind": "preemption", "notices": int(m.preempt_notices),
+            })
         req = self._pred_req.predict()
         isl = self._pred_isl.predict()
         osl = self._pred_osl.predict()

@@ -105,6 +105,7 @@ class WorkerMetricsPublisher:
     def __init__(
         self, component: Component, worker_id: int, stats_fn,
         interval_s: float = 1.0, extra_fn=None, spec_fn=None, obs_fn=None,
+        kvbm_fn=None, preempt_fn=None,
     ):
         self.component = component
         self.worker_id = worker_id
@@ -112,6 +113,10 @@ class WorkerMetricsPublisher:
         self.extra_fn = extra_fn      # () -> dict merged into the snapshot
         self.spec_fn = spec_fn        # () -> SpecDecodeStats dict ("spec" key)
         self.obs_fn = obs_fn          # () -> flight-recorder dict ("obs" key)
+        self.kvbm_fn = kvbm_fn        # () -> host-tier dict ("kvbm" key)
+        # () -> preemption dict ("preempt" key); serving assigns it after
+        # start() (the coordinator is built once the endpoint is live)
+        self.preempt_fn = preempt_fn
         self.interval_s = interval_s
         self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
         self._task: Optional[asyncio.Task] = None
@@ -153,6 +158,16 @@ class WorkerMetricsPublisher:
                     snap["obs"] = dict(obs)
             except Exception:
                 log.exception("metrics obs_fn failed")
+        if self.kvbm_fn is not None:
+            try:
+                snap["kvbm"] = dict(self.kvbm_fn())
+            except Exception:
+                log.exception("metrics kvbm_fn failed")
+        if self.preempt_fn is not None:
+            try:
+                snap["preempt"] = dict(self.preempt_fn())
+            except Exception:
+                log.exception("metrics preempt_fn failed")
         return snap
 
     async def _pump(self) -> None:

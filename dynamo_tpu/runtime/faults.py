@@ -23,6 +23,16 @@ site                        key
 ``disagg.transfer``         request id, per KV push attempt (device or
                             relay; ``truncate`` corrupts the relay frame)
 ``disagg.inject``           request id arriving at the kv_inject ingress
+``preempt.notice``          worker id receiving a maintenance notice
+                            (``drop`` = the notice is lost: no evacuation,
+                            the kill lands cold)
+``preempt.evacuate``        seat id being evacuated (``drop`` = the seat's
+                            handoff fails and it falls back to re-prefill;
+                            ``delay`` = slow evacuation against the
+                            deadline)
+``engine.stall``            dispatch window id about to be dispatched
+                            (``delay`` = the window wedges on device for
+                            ``delay_s``, exercising the stall watchdog)
 ==========================  =============================================
 
 Kinds and how sites interpret them:

@@ -35,6 +35,9 @@ class SystemServer:
         # admin drain triggers: name -> zero-arg callable kicking off a
         # graceful drain (same path as SIGINT/SIGTERM)
         self._drain_handlers: Dict[str, Callable[[], None]] = {}
+        # maintenance-notice triggers: name -> zero-arg callable kicking
+        # off an evacuating drain (runtime.preemption)
+        self._preempt_handlers: Dict[str, Callable[[], None]] = {}
         self._live = True
         self._runner: Optional[web.AppRunner] = None
 
@@ -47,6 +50,10 @@ class SystemServer:
     def register_drain(self, name: str, handler: Callable[[], None]) -> None:
         self._drain_handlers[name] = handler
 
+    def register_preempt(self, name: str,
+                         handler: Callable[[], None]) -> None:
+        self._preempt_handlers[name] = handler
+
     def set_live(self, live: bool) -> None:
         self._live = live
 
@@ -56,6 +63,7 @@ class SystemServer:
             web.get("/health", self._health),
             web.get("/live", self._livez),
             web.post("/drain", self._drain),
+            web.post("/preempt", self._preempt),
             web.get("/metrics", self._metrics),
             web.get("/debug/profile", self._profile),
             web.get("/debug/traces", self._traces),
@@ -108,6 +116,23 @@ class SystemServer:
             except Exception:
                 log.exception("drain handler %s failed", name)
         return web.json_response({"draining": fired}, status=202)
+
+    async def _preempt(self, request: web.Request) -> web.Response:
+        """Maintenance-notice trigger (the HTTP twin of the node agent's
+        SIGUSR1): evacuate in-flight KV to a peer / the host tier, then
+        drain. 202 — the evacuation runs async against its deadline."""
+        if not self._preempt_handlers:
+            return web.json_response(
+                {"error": "nothing preemptible registered"}, status=404
+            )
+        fired = []
+        for name, handler in list(self._preempt_handlers.items()):
+            try:
+                handler()
+                fired.append(name)
+            except Exception:
+                log.exception("preempt handler %s failed", name)
+        return web.json_response({"evacuating": fired}, status=202)
 
     async def _livez(self, request: web.Request) -> web.Response:
         return web.json_response({"live": self._live},

@@ -6,7 +6,6 @@ register the model, drain on signal)."""
 from __future__ import annotations
 
 import asyncio
-import signal
 from dataclasses import dataclass
 from typing import Optional
 
@@ -15,6 +14,7 @@ from .engine.engine import EngineCore
 from .llm.discovery import ModelDeploymentCard, register_llm
 from .llm.tokenizer import Tokenizer
 from .runtime.component import DistributedRuntime
+from .runtime.signals import install_shutdown_signals
 from .runtime.tasks import spawn_logged
 from .utils.config import RuntimeConfig
 from .utils.logging import get_logger
@@ -74,10 +74,12 @@ async def serve_engine(
 
         obs_gauges = EngineObsGauges(runtime.metrics, engine)
         obs_fn = obs_gauges.refresh
+    kvbm = getattr(engine, "kvbm", None)
     metrics_pub = WorkerMetricsPublisher(
         endpoint.component, runtime.primary_lease, lambda: engine.stats,
         spec_fn=st.to_dict if st is not None else None,
         obs_fn=obs_fn,
+        kvbm_fn=kvbm.snapshot if kvbm is not None else None,
     )
     metrics_pub.start()
 
@@ -195,14 +197,19 @@ async def run_until_shutdown(
     served, kv_pub, metrics_pub,
 ) -> None:
     """Install the graceful drain triggers (SIGINT/SIGTERM and, when the
-    system server is up, ``POST /drain``), then block on runtime shutdown."""
+    system server is up, ``POST /drain``), the maintenance-notice triggers
+    (SIGUSR1 / ``POST /preempt`` → evacuating drain), then block on runtime
+    shutdown."""
+    import msgpack
+
+    from .planner.connector import planner_events_subject
+    from .runtime.preemption import (
+        PreemptionCoordinator, install_preemption_signal,
+    )
+
     loop = asyncio.get_running_loop()
-    drained = {"fired": False}
 
     def _graceful():
-        if drained["fired"]:
-            return  # a second signal / POST must not start a second drain
-        drained["fired"] = True
         log.info("drain requested — deregistering and finishing in-flight "
                  "work (deadline %.1fs)", runtime.config.drain_timeout_s)
         spawn_logged(_shutdown(), name="drain-shutdown")
@@ -222,10 +229,61 @@ async def run_until_shutdown(
         await engine.stop()
         await runtime.shutdown()
 
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, _graceful)
+    # signals and POST /drain share one once-latch: whichever arrives
+    # first starts the drain, the rest are no-ops (a REPEAT signal while
+    # draining hard-exits — see runtime/signals.py)
+    guard = install_shutdown_signals(_graceful, loop=loop, name="worker-drain")
     if runtime.system_server is not None:
-        runtime.system_server.register_drain(served.endpoint.path, _graceful)
+        runtime.system_server.register_drain(
+            served.endpoint.path, guard.trigger
+        )
+
+    # maintenance notices: evacuate in-flight KV (peer / host tier / re-
+    # prefill fallback), tell the planner so it scales the replacement
+    # proactively, then run the same graceful drain
+    subject = planner_events_subject(runtime.namespace().name)
+
+    def _preempt_event(event: dict) -> None:
+        spawn_logged(
+            runtime.store.publish(
+                subject, msgpack.packb(event, use_bin_type=True)
+            ),
+            name="preempt-event",
+        )
+
+    coordinator = PreemptionCoordinator(
+        engine,
+        worker_key=served.endpoint.path,
+        notice_grace_s=runtime.config.preempt_notice_grace_s,
+        evac_deadline_s=runtime.config.preempt_evac_deadline_s,
+        journal_cap=runtime.config.preempt_journal_cap,
+        on_event=_preempt_event,
+    )
+    served.preemption = coordinator
+
+    async def _notice_then_drain(reason: str) -> None:
+        await coordinator.notice(reason)
+        guard.trigger()
+
+    def _on_notice(reason: str):
+        return lambda: spawn_logged(
+            _notice_then_drain(reason), name="preempt-notice"
+        )
+
+    try:
+        install_preemption_signal(coordinator, loop=loop, then=guard.trigger)
+    except (NotImplementedError, RuntimeError):
+        pass  # no SIGUSR1 on this platform — HTTP trigger still works
+    if runtime.system_server is not None:
+        runtime.system_server.register_preempt(
+            served.endpoint.path, _on_notice("admin")
+        )
+    metrics_pub.preempt_fn = lambda: {
+        "notices": coordinator.num_notices,
+        "evacuated_total": coordinator.num_evacuated,
+        "spilled_total": coordinator.num_spilled,
+        "fallbacks_total": coordinator.num_fallbacks,
+    }
 
     await runtime.shutdown_event.wait()
 

@@ -139,6 +139,16 @@ class RuntimeConfig:
     # orphan GC: sweep cadence + slack past an entry's deadline
     disagg_orphan_sweep_interval_s: float = 5.0
     disagg_orphan_grace_s: float = 5.0
+    # -- preemption tolerance (dynamo_tpu.runtime.preemption) --
+    # wait after a maintenance notice before evacuating, so short
+    # seats finish in place instead of paying a handoff
+    preempt_notice_grace_s: float = 2.0
+    # total wall budget for evacuating all in-flight seats; seats that
+    # miss the deadline fall back to Migration re-prefill
+    preempt_evac_deadline_s: float = 30.0
+    # max seat-state journal entries retained per worker (evacuated
+    # seats are dropped oldest-first past the cap)
+    preempt_journal_cap: int = 256
     # -- engine flight recorder (dynamo_tpu.observability) --
     # master switch for the per-step recorder + compile watchdog; the
     # recorder stamps host-known ints on already-planned syncs, so the
@@ -294,6 +304,16 @@ class RuntimeConfig:
         )
         cfg.disagg_orphan_grace_s = env_float(
             ENV_PREFIX + "DISAGG_ORPHAN_GRACE_S", cfg.disagg_orphan_grace_s
+        )
+        cfg.preempt_notice_grace_s = env_float(
+            ENV_PREFIX + "PREEMPT_NOTICE_GRACE_S", cfg.preempt_notice_grace_s
+        )
+        cfg.preempt_evac_deadline_s = env_float(
+            ENV_PREFIX + "PREEMPT_EVAC_DEADLINE_S",
+            cfg.preempt_evac_deadline_s,
+        )
+        cfg.preempt_journal_cap = env_int(
+            ENV_PREFIX + "PREEMPT_JOURNAL_CAP", cfg.preempt_journal_cap
         )
         cfg.obs_enabled = env_flag(
             ENV_PREFIX + "OBS_ENABLED", cfg.obs_enabled

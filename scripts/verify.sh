@@ -31,6 +31,11 @@
 #                                 # single-device across decode, chunked
 #                                 # prefill, spec decode; sharded weights
 #                                 # streaming + orbax sharded restore
+#   scripts/verify.sh preempt     # preemption-tolerance suite: maintenance
+#                                 # -notice evacuation parity, stall
+#                                 # watchdog, pressure ladder, chaos storms;
+#                                 # echoes the repro seed
+#                                 # (DYNTPU_CHAOS_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -137,6 +142,23 @@ if [ "${1:-}" = "disagg" ]; then
         echo "disagg suite FAILED; reproduce with e.g.:"
         for s in $seeds; do
             echo "  DYNTPU_${s} scripts/verify.sh disagg"
+        done
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "preempt" ]; then
+    set -o pipefail
+    rm -f /tmp/_preempt.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m preempt \
+        -p no:cacheprovider 2>&1 | tee /tmp/_preempt.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every preemption storm prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'CHAOS_SEED=[0-9]+' /tmp/_preempt.log | sort -u | tr '\n' ' ')
+        echo "preemption suite FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh preempt"
         done
     fi
     exit $rc

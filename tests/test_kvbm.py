@@ -63,6 +63,71 @@ def test_host_pool_disk_bf16_roundtrip(tmp_path):
     )
 
 
+def test_host_pool_bytes_accounting_incremental():
+    """g2_bytes tracks residency incrementally through put/evict cycles —
+    never recomputed over the whole pool, never drifting."""
+    pool = HostBlockPool(capacity_blocks=3)
+    per_block = sum(a.nbytes for a in block(0).values())
+    for i in range(10):
+        pool.put(i, block(i))
+        assert pool.stats.g2_bytes == per_block * min(i + 1, 3)
+    assert pool.stats.g2_blocks == 3
+    assert pool.stats.drops == 7
+    # re-putting a resident hash is an LRU touch, not a second copy
+    pool.put(9, block(9))
+    assert pool.stats.g2_bytes == per_block * 3
+
+
+def test_host_pool_drop_callback_fires_on_full_eviction(tmp_path):
+    dropped = []
+    pool = HostBlockPool(capacity_blocks=1, disk_dir=str(tmp_path),
+                         disk_capacity_blocks=1)
+    pool.on_drop = dropped.append
+    pool.put(1, block(1))
+    pool.put(2, block(2))     # 1 spills to G3 — still servable, no drop
+    assert dropped == []
+    pool.put(3, block(3))     # 2 spills, G3 over capacity: 1 leaves fully
+    assert dropped == [1]
+
+
+async def test_host_pool_concurrent_put_get_stays_bounded():
+    """Interleaved putters and getters (as the kvbm tick and the preemption
+    spill path produce) never overshoot capacity and keep the byte gauge
+    exact — the aggregator exports stats.g2_bytes as kvbm_host_pool_bytes,
+    so drift here is a lying dashboard."""
+    pool = HostBlockPool(capacity_blocks=8)
+    per_block = sum(a.nbytes for a in block(0).values())
+    errors = []
+
+    async def putter(base):
+        for i in range(40):
+            pool.put(base + i, block((base + i) % 31))
+            if len(pool._mem) > pool.capacity:
+                errors.append(f"overshoot at {base + i}")
+            if pool.stats.g2_bytes != per_block * len(pool._mem):
+                errors.append(f"byte drift at {base + i}")
+            await asyncio.sleep(0)
+
+    async def getter(base):
+        for i in range(40):
+            data = pool.get(base + i)
+            if data is not None:
+                v = float(data["k"].flat[0])
+                if v != (base + i) % 31:
+                    errors.append(f"payload mismatch for {base + i}")
+            await asyncio.sleep(0)
+
+    await asyncio.gather(putter(0), putter(1000), putter(2000),
+                         getter(0), getter(1000), getter(2000))
+    assert not errors, errors[:5]
+    assert pool.stats.g2_blocks == len(pool._mem) == 8
+    assert pool.stats.g2_bytes == per_block * 8
+    assert pool.stats.drops == 120 - 8
+    # hits + misses account for every lookup
+    total = pool.stats.g2_hits + pool.stats.g3_hits + pool.stats.misses
+    assert total == 120
+
+
 # --------------------------- engine tiering ----------------------------
 
 

@@ -270,6 +270,49 @@ def test_queue_and_breaker_signals_raise_targets():
     assert d == base_d + 2  # one decode replica per open breaker
 
 
+def test_preemption_notices_raise_decode_target():
+    planner = _planner()
+    base_p, base_d = planner.compute_replicas(50, 1024, 128)
+    planner.observe(WindowMetrics(
+        num_requests=50, isl_avg=1024, osl_avg=128, preempt_notices=2,
+    ))
+    p, d = planner.compute_replicas(50, 1024, 128)
+    # a noticed worker is capacity on its way out: scale its replacement
+    # proactively, one decode replica per notice
+    assert d == base_d + 2
+    assert p == base_p
+
+
+def test_preemption_compensation_opt_out():
+    planner = _planner(compensate_preemptions=False)
+    _, base_d = planner.compute_replicas(50, 1024, 128)
+    planner.observe(WindowMetrics(
+        num_requests=50, isl_avg=1024, osl_avg=128, preempt_notices=3,
+    ))
+    _, d = planner.compute_replicas(50, 1024, 128)
+    assert d == base_d
+
+
+async def test_make_adjustments_publishes_preemption_event():
+    events = []
+
+    class _Conn(CallbackConnector):
+        async def publish_event(self, event):
+            events.append(event)
+
+    planner = _planner(_Conn())
+    for _ in range(3):
+        planner.observe(WindowMetrics(
+            num_requests=100, isl_avg=1024, osl_avg=128,
+            preempt_notices=1,
+        ))
+    await planner.make_adjustments()
+    kinds = [e["kind"] for e in events]
+    assert "preemption" in kinds
+    pe = next(e for e in events if e["kind"] == "preemption")
+    assert pe["notices"] == 1
+
+
 # ------------------------- degradation ladder -----------------------------
 
 

@@ -1,0 +1,376 @@
+"""Preemption coordinator: seat records, the journal, and evacuation
+resume parity.
+
+The invariant everything here certifies: a seat interrupted mid-decode and
+continued elsewhere — peer KV hand-off, host-tier spill, or journal-only
+replay — emits exactly the tokens the uninterrupted run would have. Greedy
+decoding, seeded sampling, and speculative decoding all key their choices
+on (seed, absolute position), so the property must hold for all three.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.runtime.preemption import (
+    FALLBACK, PEER, SPILL, PreemptionCoordinator, SeatJournal, SeatRecord,
+)
+
+pytestmark = [pytest.mark.anyio, pytest.mark.preempt]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+MC = ModelConfig.tiny(vocab_size=256)
+
+
+def cfg(**kw):
+    return EngineConfig(
+        num_blocks=64, block_size=4, max_model_len=128,
+        max_num_batched_tokens=128, prefill_buckets=(128,),
+        decode_buckets=(4, 8), max_num_seqs=4, **kw,
+    )
+
+
+def mk_req(rid, prompt, max_tokens=8, **kw):
+    return Request(request_id=rid, token_ids=list(prompt),
+                   max_tokens=max_tokens, ignore_eos=True, **kw)
+
+
+PROMPT = [7, 3, 11, 42, 9, 100, 55, 2, 91, 13, 77, 5, 31, 8, 60, 24,
+          17, 45, 88, 6, 29, 73, 50, 12]
+
+
+async def collect(aiter):
+    """Tokens + finish reason, index-keyed: the evacuation finish frame
+    re-carries the last token and must not double-count."""
+    toks, reason = {}, None
+    async for out in aiter:
+        if out.token_id >= 0:
+            toks[out.index] = out.token_id
+        if out.finished:
+            reason = out.finish_reason
+    return [toks[i] for i in sorted(toks)], reason
+
+
+async def drive_until(engine, req, after_tokens):
+    """Start ``req`` on ``engine`` and return (task, wait) where ``wait``
+    blocks until ``after_tokens`` tokens have been emitted."""
+    progress = {"n": 0}
+
+    async def run():
+        toks, reason = {}, None
+        async for out in engine.submit(req):
+            if out.token_id >= 0:
+                toks[out.index] = out.token_id
+            progress["n"] = len(toks)
+            if out.finished:
+                reason = out.finish_reason
+        return [toks[i] for i in sorted(toks)], reason
+
+    task = asyncio.create_task(run())
+
+    async def wait():
+        while progress["n"] < after_tokens and not task.done():
+            await asyncio.sleep(0.005)
+
+    return task, wait
+
+
+# --------------------------- seat records -------------------------------
+
+
+class _FakeSeq:
+    def __init__(self, sid="s0", prompt=(1, 2, 3, 4, 5),
+                 outputs=(6, 7, 8), num_computed=7, seed=-1):
+        self.seq_id = sid
+        self.prompt_ids = list(prompt)
+        self.output_ids = list(outputs)
+        self.num_computed = num_computed
+        self.max_tokens = 8
+        self.temperature = 0.0
+        self.top_k = 0
+        self.top_p = 1.0
+        self.seed = seed
+        self.eos_token_ids = frozenset()
+
+
+def test_seat_record_token_math():
+    rec = SeatRecord.from_seq(_FakeSeq())
+    assert rec.all_tokens == [1, 2, 3, 4, 5, 6, 7, 8]
+    # peer gets the computed prefix as prompt; the frontier token is the
+    # receiver's re-emitted index-0 output
+    peer = rec.peer_request()
+    assert peer.token_ids == [1, 2, 3, 4, 5, 6, 7]
+    assert rec.first_token() == 8
+    # budget: 5 undelivered + 1 frontier re-emission
+    assert peer.max_tokens == (8 - 3) + (8 - 7)
+    # migration resume replays the full history, budget net of delivered
+    resume = rec.resume_request()
+    assert resume.token_ids == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert resume.max_tokens == 8 - 3
+    assert resume.seed is None  # -1 encodes "unseeded" on the device
+
+
+def test_seat_record_carries_seed():
+    rec = SeatRecord.from_seq(_FakeSeq(seed=17))
+    assert rec.peer_request().seed == 17
+    assert rec.resume_request().seed == 17
+
+
+def test_journal_cap_and_generation():
+    journal = SeatJournal(cap=3)
+    for i in range(5):
+        journal.record(_FakeSeq(sid=f"s{i}"))
+    assert len(journal) == 3
+    assert journal.evictions == 2
+    assert journal.get("s0") is None          # oldest evicted
+    assert journal.get("s4") is not None
+    # re-recording the same seat bumps its generation (A→B→C chains)
+    first = journal.record(_FakeSeq(sid="g"))
+    second = journal.record(_FakeSeq(sid="g"))
+    assert first.generation == 0
+    assert second.generation == 1
+
+
+# ------------------------ evacuation parity -----------------------------
+
+
+async def _reference(req) -> list:
+    ref = InferenceEngine(MC, cfg(), seed=0)
+    try:
+        want, _ = await collect(ref.submit(req))
+        return want
+    finally:
+        await ref.stop()
+
+
+async def test_evacuate_to_peer_greedy_parity():
+    src = InferenceEngine(MC, cfg(), seed=0)
+    peer = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, peer=peer, notice_grace_s=0.0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT))
+        task, wait = await drive_until(src, mk_req("r0", PROMPT), 2)
+        await wait()
+        report = await coord.notice("test")
+        got, reason = await task
+        assert reason == "evacuated"
+        assert report.count(PEER) == 1
+        res = report.results[0]
+        tail, tail_reason = await collect(
+            peer.resume_prefilled(res.dst_seq, res.record.first_token())
+        )
+        assert tail_reason in ("length", "stop")
+        assert tail[0] == got[-1]  # frontier token re-emitted
+        assert got + tail[1:] == want
+        assert not src.scheduler.running  # seat left the source cleanly
+    finally:
+        await src.stop()
+        await peer.stop()
+
+
+@pytest.mark.slow
+async def test_evacuate_seeded_sampling_parity():
+    """Sampling keys on (seed, absolute position): the evacuated tail is
+    byte-identical even at temperature, because the receiver samples the
+    same positions with the carried seed."""
+    req_kw = dict(temperature=0.9, top_k=8, seed=5)
+    src = InferenceEngine(MC, cfg(), seed=0)
+    peer = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, peer=peer, notice_grace_s=0.0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT, **req_kw))
+        task, wait = await drive_until(src, mk_req("r0", PROMPT, **req_kw), 2)
+        await wait()
+        report = await coord.notice("test")
+        got, reason = await task
+        assert reason == "evacuated"
+        res = report.results[0]
+        assert res.mode == PEER
+        assert res.record.seed == 5
+        tail, _ = await collect(
+            peer.resume_prefilled(res.dst_seq, res.record.first_token())
+        )
+        assert got + tail[1:] == want
+    finally:
+        await src.stop()
+        await peer.stop()
+
+
+@pytest.mark.slow
+async def test_double_evacuation_chain_parity():
+    """A→B→C: a seat evacuated to a peer is evacuated again mid-resume.
+    Each hop re-journals at its own frontier, so the three segments splice
+    byte-identically."""
+    a = InferenceEngine(MC, cfg(), seed=0)
+    b = InferenceEngine(MC, cfg(), seed=0)
+    c = InferenceEngine(MC, cfg(), seed=0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT, max_tokens=16))
+        coord_ab = PreemptionCoordinator(a, peer=b, notice_grace_s=0.0)
+        task, wait = await drive_until(a, mk_req("r0", PROMPT,
+                                                 max_tokens=16), 2)
+        await wait()
+        rep_ab = await coord_ab.notice("hop1")
+        got_a, _ = await task
+        res_ab = rep_ab.results[0]
+        assert res_ab.mode == PEER
+
+        # resume on B, then preempt B two tokens in (coordinator built
+        # up front so the notice parks B before its budget drains)
+        coord_bc = PreemptionCoordinator(b, peer=c, notice_grace_s=0.0)
+        progress = {"n": 0}
+
+        async def run_b():
+            toks, reason = {}, None
+            async for out in b.resume_prefilled(
+                res_ab.dst_seq, res_ab.record.first_token()
+            ):
+                if out.token_id >= 0:
+                    toks[out.index] = out.token_id
+                progress["n"] = len(toks)
+                if out.finished:
+                    reason = out.finish_reason
+            return [toks[i] for i in sorted(toks)], reason
+
+        task_b = asyncio.create_task(run_b())
+        while progress["n"] < 2 and not task_b.done():
+            await asyncio.sleep(0.005)
+        rep_bc = await coord_bc.notice("hop2")
+        got_b, reason_b = await task_b
+        assert reason_b == "evacuated"
+        res_bc = rep_bc.results[0]
+        assert res_bc.mode == PEER
+        tail_c, reason_c = await collect(
+            c.resume_prefilled(res_bc.dst_seq, res_bc.record.first_token())
+        )
+        assert reason_c in ("length", "stop")
+        assert got_a + got_b[1:] + tail_c[1:] == want
+    finally:
+        await a.stop()
+        await b.stop()
+        await c.stop()
+
+
+@pytest.mark.slow
+async def test_evacuate_spec_decode_parity():
+    """A spec-decoding source seat evacuates to a plain peer and the
+    splice still matches the plain reference (spec decode never changes
+    outputs, only how many windows it took to produce them)."""
+    spec_cfg = cfg(spec_mode="ngram", spec_k=2)
+    src = InferenceEngine(MC, spec_cfg, seed=0)
+    peer = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, peer=peer, notice_grace_s=0.0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT, max_tokens=10))
+        task, wait = await drive_until(
+            src, mk_req("r0", PROMPT, max_tokens=10), 2)
+        await wait()
+        report = await coord.notice("test")
+        got, reason = await task
+        assert reason == "evacuated"
+        res = report.results[0]
+        assert res.mode == PEER
+        tail, _ = await collect(
+            peer.resume_prefilled(res.dst_seq, res.record.first_token())
+        )
+        assert got + tail[1:] == want
+    finally:
+        await src.stop()
+        await peer.stop()
+
+
+async def test_no_peer_spills_to_host_tier():
+    """With no peer, sealed KV spills to the kvbm host pool and a resume
+    worker sharing that tier serves the replayed prefill from cache."""
+    from dynamo_tpu.kvbm.manager import KvbmConfig
+
+    src = InferenceEngine(MC, cfg(), seed=0)
+    src.attach_kvbm(KvbmConfig(host_blocks=128))
+    resume_eng = InferenceEngine(MC, cfg(), seed=0)
+    resume_eng.attach_kvbm(KvbmConfig(host_blocks=128))
+    resume_eng.kvbm.host_pool = src.kvbm.host_pool
+    coord = PreemptionCoordinator(src, notice_grace_s=0.0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT))
+        task, wait = await drive_until(src, mk_req("r0", PROMPT), 2)
+        await wait()
+        report = await coord.notice("test")
+        got, reason = await task
+        assert reason == "evacuated"
+        assert report.count(SPILL) == 1
+        res = report.results[0]
+        assert res.bytes_moved > 0
+        tail, _ = await collect(resume_eng.submit(res.record.resume_request()))
+        assert got + tail == want
+        assert resume_eng.kvbm.stats.onboarded_blocks > 0
+    finally:
+        await src.stop()
+        await resume_eng.stop()
+
+
+@pytest.mark.slow
+async def test_no_peer_no_pool_falls_back_to_journal():
+    """Nowhere to put the KV: the seat still closes cleanly and the
+    journal record alone replays it byte-identically (full re-prefill)."""
+    src = InferenceEngine(MC, cfg(), seed=0)
+    resume_eng = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, notice_grace_s=0.0)
+    try:
+        want = await _reference(mk_req("r0", PROMPT))
+        task, wait = await drive_until(src, mk_req("r0", PROMPT), 2)
+        await wait()
+        report = await coord.notice("test")
+        got, reason = await task
+        assert reason == "evacuated"
+        assert report.count(FALLBACK) == 1
+        rec = report.results[0].record
+        assert coord.journal.get(rec.seq_id) is not None
+        tail, _ = await collect(resume_eng.submit(rec.resume_request()))
+        assert got + tail == want
+    finally:
+        await src.stop()
+        await resume_eng.stop()
+
+
+async def test_notice_is_idempotent():
+    src = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, notice_grace_s=0.0)
+    try:
+        first = await coord.notice("one")
+        second = await coord.notice("two")
+        assert coord.num_notices == 1
+        assert first.results == []  # nothing in flight
+        assert second.results == []
+    finally:
+        await src.stop()
+
+
+async def test_evacuation_frees_source_blocks():
+    """After evacuation the source pool returns to its pre-request free
+    count — a preempted worker hands its blocks back before dying."""
+    src = InferenceEngine(MC, cfg(), seed=0)
+    peer = InferenceEngine(MC, cfg(), seed=0)
+    coord = PreemptionCoordinator(src, peer=peer, notice_grace_s=0.0)
+    try:
+        await src.start()
+        baseline = src.scheduler.pool.num_free
+        task, wait = await drive_until(src, mk_req("r0", PROMPT), 2)
+        await wait()
+        report = await coord.notice("test")
+        await task
+        assert report.count(PEER) == 1
+        for _ in range(50):
+            if src.scheduler.pool.num_free == baseline:
+                break
+            await asyncio.sleep(0.05)
+        assert src.scheduler.pool.num_free == baseline
+    finally:
+        await src.stop()
+        await peer.stop()

@@ -1,0 +1,157 @@
+"""Preemption chaos suite: seeded maintenance-notice storms against real
+tiny engines.
+
+Every scenario runs a source engine, a peer/resume engine, and a serial
+unfaulted reference, then interrupts the source mid-decode — a notice
+followed by evacuation, a lost notice followed by a cold kill, a wedged
+dispatch window, or HBM-pressure waves — and asserts the preemption
+invariants:
+
+- **byte parity** — every interrupted request, spliced with its resumed
+  tail (peer continuation, host-tier resume, or Migration replay),
+  matches the unfaulted reference token-for-token;
+- **zero KV corruption** — a poisoned-block canary planted in the peer
+  pool before the storm is bit-exact after it;
+- **zero leaks** — block pools return to baseline, no pending windows or
+  reservations survive, and recovery is bounded (no hung streams).
+
+Seeds come from DYNTPU_CHAOS_SEED (comma-separated) and each run prints
+``CHAOS_SEED=<n>`` so a failure reproduces with::
+
+    DYNTPU_CHAOS_SEED=<n> pytest tests/test_preemption_chaos.py -k <name>
+
+The golden-path storm stays in tier-1; the heavier storms are ``slow``
+and run with the rest of the surface via ``scripts/verify.sh preempt``.
+"""
+
+import os
+
+import pytest
+
+from dynamo_tpu.mocker.cluster import (
+    PreemptionChaosScenario, run_preemption_scenario,
+)
+
+pytestmark = [pytest.mark.anyio, pytest.mark.preempt, pytest.mark.chaos]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _seeds():
+    env = os.environ.get("DYNTPU_CHAOS_SEED")
+    if env:
+        return [int(s) for s in env.split(",")]
+    return [0]
+
+
+def _assert_invariants(report: dict) -> None:
+    print(f"CHAOS_SEED={report['seed']}")
+    print(f"preempt report: {report}")
+    assert report["completed"] == report["num_requests"], report
+    assert report["parity_failures"] == 0, report
+    assert not report["canary_corrupted"], report
+    assert report["leaked_blocks"] == 0, report
+    assert report["leaked_pending"] == 0, report
+    assert report["leaked_reservations"] == 0, report
+
+
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_notice_then_kill(seed):
+    """The golden path: a notice lands mid-decode, every seat's KV streams
+    to the peer's epoch-guarded reservation, and the peer continues each
+    stream byte-identically from the journaled frontier."""
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="notice_then_kill", mode="notice-then-kill", seed=seed,
+    ))
+    _assert_invariants(report)
+    assert report["notices"] == 1, report
+    assert report["evacuated_peer"] >= 1, report
+    assert not report["notice_lost"], report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_notice_no_peer(seed):
+    """No peer can take the seats: sealed KV spills to the shared host
+    tier, and the resume worker's kvbm serves the re-prefill from cache
+    instead of recomputing it."""
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="notice_no_peer", mode="notice-no-peer", seed=seed,
+    ))
+    _assert_invariants(report)
+    assert report["spilled"] >= 1, report
+    assert report["onboarded_blocks"] >= 1, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_kill_no_notice(seed):
+    """The notice is LOST (fault drop at preempt.notice): seats die cold
+    and recovery degrades to Migration-style replay from client state —
+    slower, but still byte-identical and leak-free."""
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="kill_no_notice", mode="kill-no-notice", seed=seed,
+    ))
+    _assert_invariants(report)
+    assert report["notice_lost"], report
+    assert report["evacuated_peer"] == 0, report
+    assert report["faults_fired"] >= 1, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_stall_mid_window(seed):
+    """A dispatch window wedges on device (engine.stall delay beyond the
+    landing deadline): the watchdog swallows the window, quarantines the
+    shape class, recomputes the touched seats, and the storm still lands
+    byte-identical."""
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="stall_mid_window", mode="stall-mid-window", seed=seed,
+    ))
+    _assert_invariants(report)
+    assert report["stalls"] >= 1, report
+    assert not report["stall_dead"], report
+    assert report["quarantined_shapes"] >= 1, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_pressure_waves(seed):
+    """An undersized pool pushes usage through the HBM-pressure ladder:
+    coldest seats spill to recompute, admission sheds above the top rung,
+    and hysteresis releases everything once the wave drains — every
+    request still completes byte-identically."""
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="pressure_waves", mode="pressure-waves", seed=seed,
+        num_requests=8, concurrency=8, max_tokens=6,
+    ))
+    _assert_invariants(report)
+    # the ladder engaged at least one rung (sheds that reopen before any
+    # admission arrives leave the counters at 0 — the peak rung is the
+    # engagement signal)
+    assert report["pressure_peak"] >= 1, report
+    assert report["pressure_level"] == 0, report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _seeds())
+async def test_storm_slow_evacuation_deadline(seed):
+    """Compound storm: every evacuation is slowed (preempt.evacuate delay)
+    against a tight deadline — seats the deadline cuts off fall back to
+    journal-only resume, and parity still holds for every seat."""
+
+    def plan(p):
+        p.delay("preempt.evacuate", 0.3)
+
+    report = await run_preemption_scenario(PreemptionChaosScenario(
+        name="slow_evacuation", mode="notice-then-kill", seed=seed,
+        evac_deadline_s=0.5, plan_fn=plan,
+    ))
+    _assert_invariants(report)
+    assert report["faults_fired"] >= 1, report
+    # whatever the deadline cut off resumed via the journal instead
+    assert (report["evacuated_peer"] + report["fallbacks"]
+            + report["spilled"]) >= 1, report

@@ -105,3 +105,60 @@ async def test_metrics_aggregator_ingests_stats():
         await runtime.shutdown()
     finally:
         await server.stop()
+
+
+async def test_aggregator_kvbm_and_preempt_gauges():
+    """kvbm/preempt snapshot keys land as per-worker gauges, zero-default
+    for workers that never publish them, and notice counts sum into the
+    planner-signals feed."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        agg = MetricsAggregator(runtime, "backend")
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        # worker 1: a pre-preemption worker — no kvbm/preempt keys at all
+        await runtime.store.publish(subject + "1", msgpack.packb({
+            "worker_id": 1, "kv_usage": 0.1, "num_requests_running": 0,
+            "num_requests_waiting": 0,
+        }))
+        # worker 2: full snapshot with host-tier + preemption counters
+        await runtime.store.publish(subject + "2", msgpack.packb({
+            "worker_id": 2, "kv_usage": 0.5, "num_requests_running": 2,
+            "num_requests_waiting": 0,
+            "kvbm": {"host_pool_bytes": 4096, "spills_total": 3},
+            "preempt": {"notices": 2, "evacuated_total": 5},
+        }))
+        for _ in range(100):
+            if {"1", "2"} <= set(agg.worker_stats):
+                break
+            await asyncio.sleep(0.01)
+        assert agg.preempt_notices() == 2
+        body = runtime.metrics.render().decode()
+        c = 'component="backend"'
+        assert f'kvbm_host_pool_bytes{{{c},worker="2"}} 4096' in body
+        assert f'kvbm_spills_total{{{c},worker="2"}} 3' in body
+        assert f'worker_preempt_notices{{{c},worker="2"}} 2' in body
+        assert f'worker_preempt_evacuated_total{{{c},worker="2"}} 5' in body
+        # the keyless worker zero-defaults instead of going unreported
+        assert f'kvbm_host_pool_bytes{{{c},worker="1"}} 0' in body
+        assert f'worker_preempt_notices{{{c},worker="1"}} 0' in body
+        # a preemption planner event lands on the transitions counter
+        agg._on_planner_event({"kind": "preemption", "worker": "w2",
+                               "notices": 2})
+        body = runtime.metrics.render().decode()
+        assert 'kind="preemption"' in body
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()

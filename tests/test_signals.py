@@ -1,0 +1,64 @@
+"""Unified shutdown signal wiring: the once-latch, signal escalation,
+and the installer."""
+
+import asyncio
+import signal
+
+import pytest
+
+from dynamo_tpu.runtime.signals import ShutdownGuard, install_shutdown_signals
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def test_trigger_fires_exactly_once():
+    calls = []
+    guard = ShutdownGuard(lambda: calls.append(1), hard_exit=lambda c: None)
+    assert guard.trigger() is True
+    assert guard.trigger() is False
+    assert guard.trigger() is False
+    assert calls == [1]
+    assert guard.fired
+
+
+def test_first_signal_triggers_second_hard_exits():
+    calls, exits = [], []
+    guard = ShutdownGuard(lambda: calls.append(1),
+                          hard_exit=lambda code: exits.append(code))
+    guard.on_signal()
+    assert calls == [1] and exits == []
+    guard.on_signal()          # drain already running: operator wants out
+    assert exits == [1]
+    assert calls == [1]        # the callback never re-fires
+
+
+def test_programmatic_retrigger_never_hard_exits():
+    exits = []
+    guard = ShutdownGuard(lambda: None,
+                          hard_exit=lambda code: exits.append(code))
+    guard.trigger()
+    # a second POST /drain is an idempotent no-op, not an escalation
+    assert guard.trigger() is False
+    assert exits == []
+
+
+async def test_install_registers_handlers_and_shares_latch():
+    loop = asyncio.get_running_loop()
+    calls, exits = [], []
+    guard = install_shutdown_signals(
+        lambda: calls.append(1), loop=loop, name="test-drain",
+        signals=(signal.SIGUSR2,),
+        hard_exit=lambda code: exits.append(code),
+    )
+    try:
+        guard.on_signal()
+        # the programmatic trigger shares the same latch: already fired
+        assert guard.trigger() is False
+        assert calls == [1] and exits == []
+    finally:
+        loop.remove_signal_handler(signal.SIGUSR2)

@@ -1,0 +1,287 @@
+"""Engine stall watchdog and HBM-pressure ladder.
+
+Stall side: a landing that blows its deadline is swallowed, its shape
+classes are quarantined (routed to the next rung up), the touched seats
+replay from their own journal (prompt + emitted tokens) byte-identically,
+bounded by stall_seq_retries, and a streak of stalls declares the worker
+dead. Pressure side: the three rungs engage at their thresholds, release
+with hysteresis, and a drained pool reopens admissions even when the loop
+is idle.
+"""
+
+import asyncio
+import types
+
+import pytest
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+
+pytestmark = [pytest.mark.anyio, pytest.mark.preempt]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+MC = ModelConfig.tiny(vocab_size=256)
+
+
+def cfg(**kw):
+    return EngineConfig(
+        num_blocks=64, block_size=4, max_model_len=128,
+        max_num_batched_tokens=128, prefill_buckets=(128,),
+        decode_buckets=(4, 8), max_num_seqs=4, **kw,
+    )
+
+
+def mk_req(rid, prompt, max_tokens=8, **kw):
+    return Request(request_id=rid, token_ids=list(prompt),
+                   max_tokens=max_tokens, ignore_eos=True, **kw)
+
+
+PROMPT = [7, 3, 11, 42, 9, 100, 55, 2, 91, 13, 77, 5, 31, 8, 60, 24,
+          17, 45, 88, 6, 29, 73, 50, 12]
+
+
+async def collect(aiter):
+    toks, reason = {}, None
+    async for out in aiter:
+        if out.token_id >= 0:
+            toks[out.index] = out.token_id
+        if out.finished:
+            reason = out.finish_reason
+    return [toks[i] for i in sorted(toks)], reason
+
+
+async def _reference(req):
+    eng = InferenceEngine(MC, cfg(), seed=0)
+    try:
+        return await collect(eng.submit(req))
+    finally:
+        await eng.stop()
+
+
+# --------------------------- stall watchdog -----------------------------
+
+
+def test_stall_deadline_scales_with_scheduled_work():
+    eng = InferenceEngine(
+        MC, cfg(stall_timeout_s=1.0, stall_timeout_per_token_s=0.01),
+        seed=0,
+    )
+    batch = types.SimpleNamespace(
+        prefills=[types.SimpleNamespace(length=100)],
+        decode_rows=[types.SimpleNamespace(accepted=2)] * 2,
+    )
+    assert eng._stall_deadline(batch) == pytest.approx(1.0 + 0.01 * 104)
+    # stall_timeout_s == 0 disables the watchdog entirely
+    eng2 = InferenceEngine(MC, cfg(), seed=0)
+    assert eng2._stall_deadline(batch) is None
+
+
+def test_quarantined_bucket_routes_to_next_rung():
+    eng = InferenceEngine(MC, cfg(), seed=0)
+    assert eng._bucket_for("decode", 3) == 4
+    eng._quarantine_shape(("decode", 4))
+    assert ("decode", 4) in eng._shape_quarantine
+    # the wedged rung is skipped; its work pads into the next one up
+    assert eng._bucket_for("decode", 3) == 8
+    assert eng._bucket_for("decode", 1) == 8
+    # an unaffected kind still buckets normally
+    assert eng._bucket_for("prefill", 30) == 128
+
+
+async def test_stall_recovery_is_byte_identical():
+    req = mk_req("stall0", PROMPT, max_tokens=8)
+    want, want_reason = await _reference(req)
+
+    plan = faults.FaultPlan(seed=0)
+    plan.delay("engine.stall", 2.0, after=3, times=1)
+    faults.install(plan)
+    eng = InferenceEngine(
+        MC,
+        cfg(stall_timeout_s=0.3, stall_seq_retries=4,
+            stall_dead_threshold=10),
+        seed=0,
+    )
+    try:
+        got, reason = await asyncio.wait_for(
+            collect(eng.submit(mk_req("stall0", PROMPT, max_tokens=8))),
+            timeout=60.0,
+        )
+    finally:
+        await eng.stop()
+        faults.clear()
+    assert plan.fired("engine.stall") >= 1
+    assert eng.num_stalls >= 1
+    assert not eng.stall_dead
+    assert eng._shape_quarantine, "stall must quarantine a shape class"
+    assert reason == want_reason
+    assert got == want, (got, want)
+
+
+async def test_stall_retries_exhausted_aborts_seat():
+    plan = faults.FaultPlan(seed=0)
+    plan.delay("engine.stall", 2.0, after=2, times=1)
+    faults.install(plan)
+    eng = InferenceEngine(
+        MC,
+        cfg(stall_timeout_s=0.3, stall_seq_retries=0,
+            stall_dead_threshold=10),
+        seed=0,
+    )
+    try:
+        got, reason = await asyncio.wait_for(
+            collect(eng.submit(mk_req("stall1", PROMPT, max_tokens=8))),
+            timeout=60.0,
+        )
+    finally:
+        await eng.stop()
+        faults.clear()
+    assert eng.num_stalls >= 1
+    assert reason == "error"
+    # no leaked state: the seat is gone and its blocks returned
+    assert not eng.scheduler.running and not eng.scheduler.waiting
+
+
+async def test_stall_streak_declares_worker_dead():
+    plan = faults.FaultPlan(seed=0)
+    plan.delay("engine.stall", 2.0, after=2, times=1)
+    faults.install(plan)
+    eng = InferenceEngine(
+        MC,
+        cfg(stall_timeout_s=0.3, stall_seq_retries=5,
+            stall_dead_threshold=1),
+        seed=0,
+    )
+    try:
+        got, reason = await asyncio.wait_for(
+            collect(eng.submit(mk_req("stall2", PROMPT, max_tokens=8))),
+            timeout=60.0,
+        )
+        assert eng.stall_dead
+        assert reason == "error"
+        with pytest.raises(RuntimeError, match="declared dead"):
+            async for _ in eng.submit(mk_req("stall3", PROMPT)):
+                pass
+    finally:
+        await eng.stop()
+        faults.clear()
+
+
+# --------------------------- pressure ladder ----------------------------
+
+
+class _DialPool:
+    """Wraps the real pool but reports a dialled usage fraction."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self.value = 0.0
+
+    @property
+    def usage(self):
+        return self.value
+
+    def __getattr__(self, name):
+        return getattr(self._pool, name)
+
+
+def _dialled_engine(**kw):
+    eng = InferenceEngine(
+        MC,
+        cfg(pressure_spill_threshold=0.5, pressure_spec_threshold=0.65,
+            pressure_shed_threshold=0.8, pressure_release=0.1, **kw),
+        seed=0,
+    )
+    dial = _DialPool(eng.scheduler.pool)
+    eng.scheduler.pool = dial
+    return eng, dial
+
+
+def test_pressure_rungs_engage_and_release_with_hysteresis():
+    eng, dial = _dialled_engine()
+    dial.value = 0.9
+    eng._pressure_tick()
+    assert eng.pressure_shedding and eng._pressure_spec_paused
+    assert eng.pressure_level == 3
+    # inside the hysteresis band: nothing releases
+    dial.value = 0.75
+    eng._pressure_tick()
+    assert eng.pressure_shedding and eng.pressure_level == 3
+    # below shed - release: admissions reopen, spec still paused
+    dial.value = 0.69
+    eng._pressure_tick()
+    assert not eng.pressure_shedding and eng._pressure_spec_paused
+    assert eng.pressure_level == 2
+    # below spec - release but above spill: rung 1 only
+    dial.value = 0.52
+    eng._pressure_tick()
+    assert not eng._pressure_spec_paused
+    assert eng.pressure_level == 1
+    dial.value = 0.2
+    eng._pressure_tick()
+    assert eng.pressure_level == 0
+
+
+def test_pressure_ladder_disabled_by_default():
+    eng = InferenceEngine(MC, cfg(), seed=0)
+    eng._pressure_tick()
+    assert eng.pressure_level == 0 and not eng.pressure_shedding
+
+
+async def test_shed_rejects_admission_and_counts():
+    eng, dial = _dialled_engine()
+    dial.value = 0.9
+    eng._pressure_tick()
+    try:
+        with pytest.raises(RuntimeError, match="admission shed"):
+            async for _ in eng.submit(mk_req("shed0", PROMPT)):
+                pass
+        assert eng.num_pressure_shed == 1
+    finally:
+        await eng.stop()
+
+
+async def test_drained_pool_reopens_admission_from_submit():
+    """The deadlock guard: if every seat drains while the shed flag is up
+    and the loop idles, submit() itself re-evaluates the ladder instead of
+    shedding forever on a stale flag."""
+    eng, dial = _dialled_engine()
+    dial.value = 0.9
+    eng._pressure_tick()
+    assert eng.pressure_shedding
+    dial.value = 0.1  # the wave drained; the idle loop never ticked
+    try:
+        got, reason = await asyncio.wait_for(
+            collect(eng.submit(mk_req("reopen0", PROMPT, max_tokens=4))),
+            timeout=60.0,
+        )
+    finally:
+        await eng.stop()
+    assert not eng.pressure_shedding
+    assert reason is not None and len(got) == 4
+
+
+def test_spec_pause_saves_and_restores_plan_window():
+    eng = InferenceEngine(
+        MC, cfg(spec_mode="ngram", spec_k=2), seed=0,
+    )
+    saved = eng.scheduler.spec_plan_window
+    assert saved is not None
+    eng._pause_spec()
+    assert eng.scheduler.spec_plan_window is None
+    eng._resume_spec()
+    assert eng.scheduler.spec_plan_window == saved
+    # idempotent: a second resume with nothing saved is a no-op
+    eng._resume_spec()
+    assert eng.scheduler.spec_plan_window == saved
