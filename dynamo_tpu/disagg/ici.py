@@ -42,7 +42,7 @@ import jax
 import numpy as np
 
 from ..engine.engine import _pow2_bucket
-from ..parallel.layout import kv_blocks_sharding
+from ..parallel.layout import kv_payload_shardings
 from ..utils.logging import get_logger
 
 log = get_logger("disagg.ici")
@@ -119,9 +119,10 @@ class DevicePlane:
             # the cross-mesh hop: device-to-device copy onto the layout's
             # [L, N, KV, bs, hd] transfer spec — KV heads over tp, the
             # same axis the destination cache shards, so the scatter
-            # never reshards
-            sharding = kv_blocks_sharding(dst_engine.mesh)
-            data = jax.device_put(data, {"k": sharding, "v": sharding})
+            # never reshards.  Quantized payloads carry the float32 scale
+            # caches ("ks"/"vs") under their own scale spec.
+            data = jax.device_put(
+                data, kv_payload_shardings(dst_engine.mesh, data.keys()))
 
         def _scatter():
             if dst_epoch is not None and not dst_engine.reservation_valid(
@@ -135,8 +136,8 @@ class DevicePlane:
             )
 
         await src_loop.run_in_executor(dst_engine._executor, _scatter)
-        k = data["k"]
-        return 2 * k.size * k.dtype.itemsize  # k + v, padded payload
+        # every payload tensor counts: k + v (+ ks + vs scales), padded
+        return sum(a.size * a.dtype.itemsize for a in data.values())
 
 
 # A process-wide default plane: workers in one process (launcher-spawned

@@ -9,6 +9,12 @@ Every frame carries an integrity envelope: the byte length implied by
 side verifies the envelope *before* scattering into reserved blocks, so a
 truncated, bit-flipped, or dtype-mangled relay payload is rejected (the
 handoff falls back / retries) instead of poisoning the KV cache.
+
+Quantized KV (``EngineConfig.kv_dtype`` int8/fp8) payloads carry two extra
+tensors — the float32 per-(slot, head) scale caches ``ks``/``vs`` — with
+their own shape/dtype/CRC entries in the same envelope, so dtype and
+scales survive the handoff bit-exactly.  Frames without them decode to a
+plain {"k", "v"} pair, keeping older bf16 peers interoperable.
 """
 
 from __future__ import annotations
@@ -18,10 +24,13 @@ from typing import Dict
 
 import numpy as np
 
-try:  # bfloat16 numpy interop (jax dependency, always present with jax)
+try:  # 1-byte-storage numpy interop (jax dependency, always present w/ jax)
     import ml_dtypes
 
-    _DTYPES = {"bfloat16": np.dtype(ml_dtypes.bfloat16)}
+    _DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+    }
 except Exception:  # pragma: no cover
     _DTYPES = {}
 
@@ -38,11 +47,11 @@ def _np_dtype(name: str) -> np.dtype:
 
 
 def kv_to_wire(data: Dict[str, np.ndarray]) -> dict:
-    """{"k","v"} arrays -> msgpack-safe dict (raw bytes + shape + dtype +
-    per-tensor CRC32)."""
+    """{"k","v"[,"ks","vs"]} arrays -> msgpack-safe dict (raw bytes +
+    shape + dtype + per-tensor CRC32)."""
     k, v = data["k"], data["v"]
     kb, vb = k.tobytes(), v.tobytes()
-    return {
+    wire = {
         "shape": list(k.shape),
         "dtype": k.dtype.name,
         "k": kb,
@@ -50,6 +59,18 @@ def kv_to_wire(data: Dict[str, np.ndarray]) -> dict:
         "k_crc": zlib.crc32(kb),
         "v_crc": zlib.crc32(vb),
     }
+    if "ks" in data:
+        ks, vs = data["ks"], data["vs"]
+        ksb, vsb = ks.tobytes(), vs.tobytes()
+        wire.update({
+            "scale_shape": list(ks.shape),
+            "scale_dtype": ks.dtype.name,
+            "ks": ksb,
+            "vs": vsb,
+            "ks_crc": zlib.crc32(ksb),
+            "vs_crc": zlib.crc32(vsb),
+        })
+    return wire
 
 
 def _verify(name: str, buf: bytes, nbytes: int, crc) -> None:
@@ -64,10 +85,12 @@ def _verify(name: str, buf: bytes, nbytes: int, crc) -> None:
 def kv_from_wire(wire: dict) -> Dict[str, np.ndarray]:
     """Decode and *verify* a wire frame. Raises :class:`KvIntegrityError`
     on truncation, checksum mismatch, or a dtype/shape that doesn't match
-    the byte payload — never returns a partially-valid tensor pair.
+    the byte payload — never returns a partially-valid tensor set.
 
     Frames without ``k_crc``/``v_crc`` (older peers) still get the
-    size check; the checksum is skipped.
+    size check; the checksum is skipped.  Frames with ``ks``/``vs``
+    (quantized KV) verify and return the scale tensors under the same
+    contract.
     """
     shape = tuple(int(d) for d in wire["shape"])
     dt = _np_dtype(wire["dtype"])
@@ -75,7 +98,18 @@ def kv_from_wire(wire: dict) -> Dict[str, np.ndarray]:
     kb, vb = wire["k"], wire["v"]
     _verify("k", kb, nbytes, wire.get("k_crc"))
     _verify("v", vb, nbytes, wire.get("v_crc"))
-    return {
+    out = {
         "k": np.frombuffer(kb, dtype=dt).reshape(shape),
         "v": np.frombuffer(vb, dtype=dt).reshape(shape),
     }
+    if "ks" in wire:
+        s_shape = tuple(int(d) for d in wire["scale_shape"])
+        s_dt = _np_dtype(wire["scale_dtype"])
+        s_nbytes = int(np.prod(s_shape)) * s_dt.itemsize \
+            if s_shape else s_dt.itemsize
+        ksb, vsb = wire["ks"], wire["vs"]
+        _verify("ks", ksb, s_nbytes, wire.get("ks_crc"))
+        _verify("vs", vsb, s_nbytes, wire.get("vs_crc"))
+        out["ks"] = np.frombuffer(ksb, dtype=s_dt).reshape(s_shape)
+        out["vs"] = np.frombuffer(vsb, dtype=s_dt).reshape(s_shape)
+    return out

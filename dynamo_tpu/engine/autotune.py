@@ -56,8 +56,9 @@ SWEEP_ENV = "DYNTPU_AUTOTUNE_SWEEP"
 CACHE_VERSION = 1
 
 # minimum second-to-minor tile dim per dtype (pallas_guide.md): kv_tile is
-# the second-to-last axis of the (1, KV, kv_tile, hd) K/V block
-_SUBLANE = {"float32": 8, "bfloat16": 16}
+# the second-to-last axis of the (1, KV, kv_tile, hd) K/V block.  Quantized
+# paged caches store 1-byte elements, whose native tile is (32, 128).
+_SUBLANE = {"float32": 8, "bfloat16": 16, "int8": 32, "fp8": 32}
 
 
 def _time_attention(fn, args, iters: int = 20) -> float:
@@ -239,8 +240,14 @@ def tile_candidates(
     (f32: 8, bf16: 16) since it is the second-to-minor axis of the K/V
     block DMA.
     """
+    from . import quant
+
     bs = engine_config.block_size
-    sub = _sublane(model_config.dtype)
+    # the K/V page DMA's sublane floor follows the *storage* dtype: the
+    # model dtype for bf16 passthrough, the 1-byte tile for quantized KV
+    page_dtype = engine_config.kv_dtype \
+        if quant.is_quantized(engine_config.kv_dtype) else model_config.dtype
+    sub = _sublane(page_dtype)
     kv_tiles = [0] + [
         kt for kt in (8, 16, 32, 64, 128)
         if kt >= sub and kt < bs and bs % kt == 0
@@ -268,7 +275,15 @@ def make_sweep_case(
     (spec/prefill), a dead seat whose table is all trash (block 0), and —
     with ``poison`` — NaN bits in the trash block and every partial block
     tail, so a tile candidate that mis-masks can never pass the gate.
+
+    With a quantized ``engine_config.kv_dtype`` the caches are quantized
+    per (slot, head) and the case carries the parallel ``k_scale`` /
+    ``v_scale`` arrays; poisoning then NaNs the *scales* of trash/tail
+    slots (and the fp8 payload, which can encode NaN) — a candidate that
+    dequantizes a masked slot before zeroing it still fails the gate.
     """
+    from . import quant
+
     bs = engine_config.block_size
     W = W or max(2, min(8, engine_config.max_blocks_per_seq))
     KV = model_config.num_kv_heads
@@ -295,22 +310,39 @@ def make_sweep_case(
     v_cache = rng.standard_normal((nb, KV, bs, hd)).astype(np.float32)
     tables = np.zeros((B, W), np.int32)
     nxt = 1
+    poison_slots = []  # (block, first poisoned slot offset)
     for r, (ql, cl) in enumerate(rows):
         for w in range((cl + bs - 1) // bs):
             tables[r, w] = nxt
             nxt += 1
         if poison and cl % bs:
-            blk = tables[r, cl // bs]
-            k_cache[blk, :, cl % bs:] = np.nan
-            v_cache[blk, :, cl % bs:] = np.nan
+            poison_slots.append((int(tables[r, cl // bs]), cl % bs))
     if poison:
-        k_cache[0] = np.nan
-        v_cache[0] = np.nan
+        poison_slots.append((0, 0))  # the trash block, wholesale
+
+    kv_dtype = engine_config.kv_dtype
+    quantized = quant.is_quantized(kv_dtype)
+    k_scale = v_scale = None
+    if quantized:
+        # quantize the clean values first, then poison the quantized form
+        k_cache, k_scale = quant.kv_quantize_cache_np(k_cache, kv_dtype)
+        v_cache, v_scale = quant.kv_quantize_cache_np(v_cache, kv_dtype)
+    for blk, off in poison_slots:
+        if quantized:
+            k_scale[blk, :, off:] = np.nan
+            v_scale[blk, :, off:] = np.nan
+            if kv_dtype == "fp8":  # e4m3fn encodes NaN; int8 cannot
+                k_cache[blk, :, off:] = np.nan
+                v_cache[blk, :, off:] = np.nan
+        else:
+            k_cache[blk, :, off:] = np.nan
+            v_cache[blk, :, off:] = np.nan
     if dt is None:
         import jax.numpy as jnp
         q = np.asarray(jnp.asarray(q, jnp.bfloat16))
-        k_cache = np.asarray(jnp.asarray(k_cache, jnp.bfloat16))
-        v_cache = np.asarray(jnp.asarray(v_cache, jnp.bfloat16))
+        if not quantized:
+            k_cache = np.asarray(jnp.asarray(k_cache, jnp.bfloat16))
+            v_cache = np.asarray(jnp.asarray(v_cache, jnp.bfloat16))
     return {
         "attn_class": attn_class,
         "args": (
@@ -319,6 +351,9 @@ def make_sweep_case(
             np.asarray([r[0] for r in rows], np.int32),
             np.asarray([r[1] for r in rows], np.int32),
         ),
+        "k_scale": k_scale,
+        "v_scale": v_scale,
+        "kv_dtype": kv_dtype,
         "block_size": bs,
         "max_q_len": T,
     }
@@ -327,6 +362,7 @@ def make_sweep_case(
 def reference_ragged(
     q, k_cache, v_cache, tables, q_start, q_len, ctx_len, *,
     block_size: int, max_q_len: int, q_tile: int = 0, kv_tile: int = 0,
+    k_scale=None, v_scale=None,
 ) -> np.ndarray:
     """Order-exact reference for one ``(q_tile, kv_tile)`` candidate.
 
@@ -359,6 +395,8 @@ def reference_ragged(
     q4 = jnp.asarray(q).reshape(Tq, KV, G, hd).transpose(1, 0, 2, 3)
     kc = jnp.asarray(k_cache)
     vc = jnp.asarray(v_cache)
+    ks = jnp.asarray(k_scale) if k_scale is not None else None
+    vs = jnp.asarray(v_scale) if v_scale is not None else None
     out = np.zeros((KV, Tq, G, hd), np.asarray(q).dtype)
     for r in range(R):
         qs, qe = int(q_start[r]), int(q_start[r + 1])
@@ -380,6 +418,11 @@ def reference_ragged(
                            (w % splits + 1) * kv_tile)
                 k = kc[blk][:, sl].astype(jnp.float32)
                 v = vc[blk][:, sl].astype(jnp.float32)
+                if ks is not None:
+                    # same op order as the kernel: dequantize, THEN the
+                    # kvalid zeroing wipes trash/tail bits (NaN scales incl.)
+                    k = k * ks[blk][:, sl].astype(jnp.float32)[..., None]
+                    v = v * vs[blk][:, sl].astype(jnp.float32)[..., None]
                 kpos = w * kv_tile + jax.lax.broadcasted_iota(
                     jnp.int32, (1, kv_tile, 1), 1)
                 kvalid = kpos < cl
@@ -469,20 +512,29 @@ def parity_check(
     import jax.numpy as jnp
 
     from ..ops.paged_attention import paged_attention_ragged
+    from . import quant
 
     q, kc, vc, tables, q_start, q_len, ctx_len = case["args"]
+    ks, vs = case.get("k_scale"), case.get("v_scale")
     out = np.asarray(paged_attention_ragged(
         jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
         jnp.asarray(tables), jnp.asarray(q_start), jnp.asarray(q_len),
         jnp.asarray(ctx_len),
         block_size=case["block_size"], max_q_len=case["max_q_len"],
         q_tile=q_tile, kv_tile=kv_tile, interpret=True,
+        k_scale=None if ks is None else jnp.asarray(ks),
+        v_scale=None if vs is None else jnp.asarray(vs),
     ))
     exact = reference_ragged(
         q, kc, vc, tables, q_start, q_len, ctx_len,
         block_size=case["block_size"], max_q_len=case["max_q_len"],
-        q_tile=q_tile, kv_tile=kv_tile,
+        q_tile=q_tile, kv_tile=kv_tile, k_scale=ks, v_scale=vs,
     )
+    if ks is not None:
+        # anchor on the dequantized caches: quantization error is shared
+        # by kernel and anchor, leaving only accumulation-order noise
+        kc = quant.kv_dequantize_cache_np(kc, ks)
+        vc = quant.kv_dequantize_cache_np(vc, vs)
     naive = reference_naive(
         q, kc, vc, tables, q_start, q_len, ctx_len,
         block_size=case["block_size"],
@@ -523,6 +575,17 @@ def sweep_class_parity(
     ]
 
 
+def _ragged_scaled(q, kc, vc, tables, q_start, q_len, ctx_len,
+                   k_scale, v_scale, **kw):
+    """Positional-scales wrapper so the timing loop's ``fn(*args)`` shape
+    works for both passthrough and quantized-KV candidates."""
+    from ..ops.paged_attention import paged_attention_ragged
+
+    return paged_attention_ragged(
+        q, kc, vc, tables, q_start, q_len, ctx_len,
+        k_scale=k_scale, v_scale=v_scale, **kw)
+
+
 def _sweep_class_device(
     model_config: ModelConfig, engine_config: EngineConfig,
     attn_class: str, B: int, T: int,
@@ -539,11 +602,14 @@ def _sweep_class_device(
     import jax.numpy as jnp
 
     from ..ops.paged_attention import paged_attention_ragged
+    from . import quant
 
     bs = engine_config.block_size
     cap = engine_config.max_blocks_per_seq
     widths = sorted({max(2, min(8, cap)), max(2, min(32, cap))})
     tol = 2e-2 if model_config.dtype == "bfloat16" else 2e-3
+    if quant.is_quantized(engine_config.kv_dtype):
+        tol = max(tol, 5e-2)  # quantization error rides the same anchor
     results: List[dict] = []
     for q_tile, kv_tile in tile_candidates(
             model_config, engine_config, attn_class, T):
@@ -556,19 +622,28 @@ def _sweep_class_device(
                 W=W, poison=False)
             q, kc, vc, tables, q_start, q_len, ctx_len = (
                 jnp.asarray(a) for a in case["args"])
+            ks_np, vs_np = case.get("k_scale"), case.get("v_scale")
             # one throwaway wrapper per candidate BY DESIGN: each (q_tile,
             # kv_tile) is a distinct static config, so no cache is shared
             # and this cold startup sweep never runs in the serving loop
             fn = jax.jit(functools.partial(  # dynalint: disable=DT203
-                paged_attention_ragged,
+                paged_attention_ragged if ks_np is None else _ragged_scaled,
                 block_size=bs, max_q_len=T,
                 q_tile=q_tile, kv_tile=kv_tile,
             ))
             args = (q, kc, vc, tables, q_start, q_len, ctx_len)
+            if ks_np is not None:
+                args = args + (jnp.asarray(ks_np), jnp.asarray(vs_np))
             try:
                 out = np.asarray(fn(*args))
+                kc_h, vc_h = np.asarray(kc), np.asarray(vc)
+                if ks_np is not None:
+                    kc_h = quant.kv_dequantize_cache_np(kc_h, ks_np)
+                    vc_h = quant.kv_dequantize_cache_np(vc_h, vs_np)
                 ref = np.asarray(reference_naive(
-                    *[np.asarray(a) for a in args], block_size=bs))
+                    np.asarray(q), kc_h, vc_h, np.asarray(tables),
+                    np.asarray(q_start), np.asarray(q_len),
+                    np.asarray(ctx_len), block_size=bs))
                 mask = np.zeros(out.shape[0], bool)
                 ql_h = np.asarray(q_len)
                 qs_h = np.asarray(q_start)
@@ -629,6 +704,9 @@ def config_hash(
             "max_model_len": engine_config.max_model_len,
             "max_num_seqs": engine_config.max_num_seqs,
             "mesh_shape": list(engine_config.mesh_shape),
+            # storage dtype changes the K/V DMA tile economics, so quant
+            # winners never leak into bf16 runs (or vice versa)
+            "kv_dtype": engine_config.kv_dtype,
         },
         "device_kind": device_kind,
         "jax": jax.__version__,
@@ -771,14 +849,14 @@ def autotune_attention(
 # ---------------------------------------------------------------------------
 
 
-def parity_selftest(seed: int = 0) -> dict:
+def parity_selftest(seed: int = 0, kv_dtype: str = "bf16") -> dict:
     """Every candidate of every class through the bitwise gate on CPU."""
     model_config = ModelConfig.tiny()
     engine_config = EngineConfig(
         block_size=16, num_blocks=128, max_num_seqs=8,
         max_num_batched_tokens=256, max_model_len=256,
         decode_buckets=(8,), prefill_buckets=(16, 32),
-        spec_mode="ngram", spec_k=3,
+        spec_mode="ngram", spec_k=3, kv_dtype=kv_dtype,
     )
     report: dict = {
         "fusion_disabled": "--xla_disable_hlo_passes=fusion"

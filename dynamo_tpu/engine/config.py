@@ -212,6 +212,14 @@ class EngineConfig:
     pressure_shed_threshold: float = 0.0
     # hysteresis: a rung releases once usage < threshold - pressure_release
     pressure_release: float = 0.05
+    # quantized serving (engine/quant.py): "bf16" keeps the model dtype
+    # end to end (byte-identical to the pre-quant code path); "int8"/"fp8"
+    # store weights / paged KV in 1 byte per element with per-channel
+    # (weights) or per-token-per-head (KV) float32 scales riding the same
+    # pytrees. Validated here so a bad dtype fails at startup, not at the
+    # first dispatch.
+    weight_dtype: str = "bf16"          # "bf16" | "int8" | "fp8"
+    kv_dtype: str = "bf16"              # "bf16" | "int8" | "fp8"
 
     def __post_init__(self):
         if len(self.mesh_shape) not in (2, 3):
@@ -273,6 +281,15 @@ class EngineConfig:
                 )
         if self.pressure_release < 0:
             raise ValueError("pressure_release must be >= 0")
+        for knob in ("weight_dtype", "kv_dtype"):
+            v = getattr(self, knob)
+            if v not in ("bf16", "int8", "fp8"):
+                raise ValueError(
+                    f"unknown {knob} {v!r} (expected bf16|int8|fp8)"
+                )
+        if (self.weight_dtype != "bf16" or self.kv_dtype != "bf16") \
+                and self.pp_stages > 1:
+            raise ValueError("quantized serving requires pp_stages == 1")
         # max_num_batched_tokens MAY exceed the largest prefill bucket:
         # the scheduler caps each chunk at the bucket, so extra budget
         # just lets decode seats coexist with a full-bucket prefill

@@ -41,6 +41,7 @@ from ..utils.hotpath import hot_path
 from ..utils.logging import get_logger
 from .config import EngineConfig, ModelConfig
 from . import model as model_lib
+from . import quant
 from .scheduler import (
     KvEvent, PrefillChunk, SchedSeq, Scheduler, SchedulerStats, SeqStatus,
 )
@@ -1296,12 +1297,19 @@ class InferenceEngine(EngineCore):
             self.mesh = model_lib.make_mesh(
                 engine_config.mesh_shape, devices
             )
+            # quantize-at-init for random/host params; params streamed by
+            # load_hf_params_sharded arrive already quantized (dict
+            # leaves) and pass through unchanged
+            params = quant.quantize_params(
+                params, engine_config.weight_dtype
+            )
             self.params = model_lib.shard_params(
-                params, self.mesh, model_config
+                params, self.mesh, model_config,
+                engine_config.weight_dtype,
             )
             self.cache = model_lib.shard_cache(
                 model_lib.init_cache(model_config, engine_config),
-                self.mesh, model_config,
+                self.mesh, model_config, engine_config.kv_dtype,
             )
             self._step_fn = model_lib.make_step_fn(
                 model_config, engine_config, self.mesh
@@ -1380,7 +1388,11 @@ class InferenceEngine(EngineCore):
                 peak_flops=obs_flops.peak_flops(
                     getattr(dev0, "device_kind", ""),
                     getattr(dev0, "platform", "cpu"),
-                    model_config.dtype,
+                    # quantized weights run the matmuls at the int8/fp8
+                    # roofline — MFU against the bf16 peak would flatter
+                    engine_config.weight_dtype
+                    if quant.is_quantized(engine_config.weight_dtype)
+                    else model_config.dtype,
                 ),
                 window_s=env_float("DYNTPU_OBS_WINDOW_S", 10.0),
                 jsonl_path=env_str("DYNTPU_OBS_STEPSTATS_PATH", ""),
@@ -1477,10 +1489,13 @@ class InferenceEngine(EngineCore):
 
         def _ex():
             data = self._kv_extract(self.cache, padded)
-            return {
-                "k": np.asarray(jax.device_get(data["k"]))[:, :n],
-                "v": np.asarray(jax.device_get(data["v"]))[:, :n],
-            }
+            # quantized caches carry "ks"/"vs" scale planes alongside the
+            # pages; slice the pad off every key uniformly
+            # D2H is the point here: extract feeds the kvbm host tier /
+            # the relay, off the step path
+            data = jax.device_get(data)  # dynalint: disable=DT102
+            return {key: np.asarray(arr)[:, :n]
+                    for key, arr in data.items()}
 
         return await loop.run_in_executor(self._executor, _ex)
 
@@ -1503,13 +1518,15 @@ class InferenceEngine(EngineCore):
         padded = np.zeros((m,), np.int32)
         padded[:n] = block_ids
         if m != n:
-            pad_shape = list(data["k"].shape)
-            pad_shape[1] = m - n
-            pad = np.zeros(pad_shape, data["k"].dtype)
-            data = {
-                "k": np.concatenate([data["k"], pad], axis=1),
-                "v": np.concatenate([data["v"], pad], axis=1),
-            }
+
+            def _pad(a: np.ndarray) -> np.ndarray:
+                pad_shape = list(a.shape)
+                pad_shape[1] = m - n
+                return np.concatenate(
+                    [a, np.zeros(pad_shape, a.dtype)], axis=1
+                )
+
+            data = {key: _pad(a) for key, a in data.items()}
 
         def _in():
             if epoch is not None and not self.reservation_valid(seq_id, epoch):
@@ -1542,7 +1559,8 @@ class InferenceEngine(EngineCore):
         compiles O(log T) encode programs."""
         if self._encode_fn is None:
             self._encode_fn = model_lib.make_encode_fn(
-                self.model_config, None if self.pp > 1 else self.mesh
+                self.model_config, None if self.pp > 1 else self.mesh,
+                self.config.weight_dtype,
             )
         loop = asyncio.get_running_loop()
 

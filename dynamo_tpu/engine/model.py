@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding
 from ..observability import compilewatch
 from ..parallel import layout
 from ..parallel.layout import AXIS_TP, SpecLayout, make_mesh
+from . import quant
 from .config import EngineConfig, ModelConfig
 
 Params = Dict[str, Any]
@@ -109,6 +110,19 @@ def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
     measured ~90 ms/step of pure copies on v5e for a 1B model."""
     dt = _dtype(cfg)
     shape = (eng.num_blocks, cfg.num_kv_heads, eng.block_size, cfg.head_dim_)
+    if quant.is_quantized(eng.kv_dtype):
+        # quantized pages (1 byte/elem) plus per-(slot, head) f32 scale
+        # planes; the trash block's zero scales dequantize to exact zeros
+        dt = quant.storage_dtype(eng.kv_dtype)
+        sshape = shape[:-1]
+        return {
+            "k": [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
+            "v": [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
+            "ks": [jnp.zeros(sshape, jnp.float32)
+                   for _ in range(cfg.num_layers)],
+            "vs": [jnp.zeros(sshape, jnp.float32)
+                   for _ in range(cfg.num_layers)],
+        }
     return {
         "k": [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
         "v": [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
@@ -118,16 +132,20 @@ def init_cache(cfg: ModelConfig, eng: EngineConfig) -> Cache:
 # ---------------------------- shardings ----------------------------------
 
 
-def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
+def param_shardings(mesh: Mesh, cfg: ModelConfig,
+                    weight_dtype: str = "bf16") -> Params:
     """The canonical per-parameter table (see ``SpecLayout``): Megatron
     column/row TP over ``tp``, parameter storage over ``fsdp`` when the
-    mesh carries one, vocab-sharded embed/lm_head."""
-    return SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
+    mesh carries one, vocab-sharded embed/lm_head. A quantized
+    ``weight_dtype`` mirrors the ``{"q", "s"}`` leaf structure."""
+    return SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg,
+                                                     weight_dtype)
 
 
-def cache_shardings(mesh: Mesh, cfg: ModelConfig) -> Cache:
+def cache_shardings(mesh: Mesh, cfg: ModelConfig,
+                    kv_dtype: str = "bf16") -> Cache:
     # KV heads sharded over tp so each shard holds the heads it computes
-    return SpecLayout.for_mesh(mesh).cache_shardings(mesh, cfg)
+    return SpecLayout.for_mesh(mesh).cache_shardings(mesh, cfg, kv_dtype)
 
 
 def _multi(mesh: Optional[Mesh]) -> bool:
@@ -137,22 +155,26 @@ def _multi(mesh: Optional[Mesh]) -> bool:
 
 
 def _io_kwargs(mesh: Optional[Mesh], cfg: ModelConfig, n_repl_in: int,
-               outs: Tuple[str, ...]) -> Dict[str, Any]:
+               outs: Tuple[str, ...],
+               eng: Optional[EngineConfig] = None) -> Dict[str, Any]:
     """``jax.jit`` in/out sharding kwargs for a step-family function whose
     leading args are (params, cache) followed by ``n_repl_in`` replicated
     data/control args. ``outs`` names each output: "cache" (paged-cache
     layout) or "repl". Pinning both sides to the canonical layout means a
     mis-sharded arg is resharded at the boundary instead of silently
-    recompiling a differently-partitioned program."""
+    recompiling a differently-partitioned program. ``eng`` (when given)
+    carries the quantization dtypes so the scale leaves get their specs."""
     if not _multi(mesh):
         return {}
+    wd = eng.weight_dtype if eng is not None else "bf16"
+    kd = eng.kv_dtype if eng is not None else "bf16"
     lay = SpecLayout.for_mesh(mesh)
     repl = layout.replicated(mesh)
-    pick = {"cache": lay.cache_shardings(mesh, cfg), "repl": repl}
+    pick = {"cache": lay.cache_shardings(mesh, cfg, kd), "repl": repl}
     return {
         "in_shardings": (
-            lay.param_shardings(mesh, cfg),
-            lay.cache_shardings(mesh, cfg),
+            lay.param_shardings(mesh, cfg, wd),
+            lay.cache_shardings(mesh, cfg, kd),
         ) + (repl,) * n_repl_in,
         "out_shardings": tuple(pick[o] for o in outs),
     }
@@ -166,12 +188,14 @@ def _repl_kwargs(mesh: Optional[Mesh], n_in: int) -> Dict[str, Any]:
     return {"in_shardings": (repl,) * n_in, "out_shardings": repl}
 
 
-def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
-    return jax.device_put(params, param_shardings(mesh, cfg))
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig,
+                 weight_dtype: str = "bf16") -> Params:
+    return jax.device_put(params, param_shardings(mesh, cfg, weight_dtype))
 
 
-def shard_cache(cache: Cache, mesh: Mesh, cfg: ModelConfig) -> Cache:
-    return jax.device_put(cache, cache_shardings(mesh, cfg))
+def shard_cache(cache: Cache, mesh: Mesh, cfg: ModelConfig,
+                kv_dtype: str = "bf16") -> Cache:
+    return jax.device_put(cache, cache_shardings(mesh, cfg, kv_dtype))
 
 
 # ----------------------------- modules -----------------------------------
@@ -199,6 +223,39 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
         [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
     )
     return out.astype(x.dtype)
+
+
+def _mm(x: jax.Array, w: Any) -> jax.Array:
+    """Matmul against a possibly-quantized weight leaf.
+
+    Plain arrays take the literal ``x @ w`` — the default (bf16) path
+    traces the exact pre-quant jaxpr, byte-identical outputs. Quantized
+    ``{"q", "s"}`` leaves matmul the 1-byte weights (cast fuses into the
+    MXU feed, so only the int8/fp8 bytes move from HBM) and apply the
+    per-output-channel scale to the product — exact, because the scale is
+    constant along the contraction axis."""
+    if isinstance(w, dict):
+        y = x @ w["q"].astype(x.dtype)
+        return (y.astype(jnp.float32) * w["s"][0]).astype(x.dtype)
+    return x @ w
+
+
+def _layer_slice(stacked: Dict[str, Any], li: int) -> Dict[str, Any]:
+    """Static per-layer slice of the stacked param tree (a read, not a
+    copy); quantized ``{"q", "s"}`` leaves slice both members."""
+    return {
+        name: ({k: v[li] for k, v in w.items()} if isinstance(w, dict)
+               else w[li])
+        for name, w in stacked.items()
+    }
+
+
+def _dequant_leaf(w: Any, dtype) -> jax.Array:
+    """Full dequantization for consumers that need a plain array (MoE
+    expert dispatch)."""
+    if isinstance(w, dict):
+        return (w["q"].astype(jnp.float32) * w["s"]).astype(dtype)
+    return w
 
 
 _Q_BLOCK = 512  # query-block size for long prefill chunks: caps the f32
@@ -295,6 +352,8 @@ def _paged_decode_attention(
     lv: jax.Array,           # [NB, KV, bs, hd]
     block_tables: jax.Array,  # [B, W]
     seq_lens: jax.Array,      # [B] valid context incl. current token
+    lks: Optional[jax.Array] = None,  # [NB, KV, bs] f32 scales (quant kv)
+    lvs: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode-path attention via the Pallas paged kernel ([B, 1, H, hd]).
 
@@ -316,17 +375,32 @@ def _paged_decode_attention(
     if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
         lay = SpecLayout.for_mesh(mesh)
         heads = layout.spec(None, lay.tp, None)
-        out = layout.shard_map(
-            lambda q_, k_, v_, t_, s_: kernel(q_, k_, v_, t_, s_),
-            mesh=mesh,
-            in_specs=(
-                heads, lay.cache_block(), lay.cache_block(),
-                layout.spec(None, None), layout.spec(None),
-            ),
-            out_specs=heads,
-        )(q3, lk, lv, block_tables, seq_lens)
+        if lks is not None:
+            out = layout.shard_map(
+                lambda q_, k_, v_, t_, s_, ks_, vs_: kernel(
+                    q_, k_, v_, t_, s_, k_scale=ks_, v_scale=vs_
+                ),
+                mesh=mesh,
+                in_specs=(
+                    heads, lay.cache_block(), lay.cache_block(),
+                    layout.spec(None, None), layout.spec(None),
+                    lay.cache_scale_block(), lay.cache_scale_block(),
+                ),
+                out_specs=heads,
+            )(q3, lk, lv, block_tables, seq_lens, lks, lvs)
+        else:
+            out = layout.shard_map(
+                lambda q_, k_, v_, t_, s_: kernel(q_, k_, v_, t_, s_),
+                mesh=mesh,
+                in_specs=(
+                    heads, lay.cache_block(), lay.cache_block(),
+                    layout.spec(None, None), layout.spec(None),
+                ),
+                out_specs=heads,
+            )(q3, lk, lv, block_tables, seq_lens)
     else:
-        out = kernel(q3, lk, lv, block_tables, seq_lens)
+        out = kernel(q3, lk, lv, block_tables, seq_lens,
+                     k_scale=lks, v_scale=lvs)
     return out[:, None]
 
 
@@ -339,6 +413,8 @@ def _paged_ragged_attention(
     block_tables: jax.Array,  # [B, W]
     q_len: jax.Array,         # [B] valid (prefix) queries per row, 0 = dead
     ctx_len: jax.Array,       # [B] context incl. the row's own tokens
+    lks: Optional[jax.Array] = None,  # [NB, KV, bs] f32 scales (quant kv)
+    lvs: Optional[jax.Array] = None,
 ) -> jax.Array:
     """T>1 attention (spec windows, prefill chunks) via the ragged kernel.
 
@@ -365,20 +441,38 @@ def _paged_ragged_attention(
     if mesh is not None and mesh.shape.get(AXIS_TP, 1) > 1:
         lay = SpecLayout.for_mesh(mesh)
         heads = layout.spec(None, lay.tp, None)
-        out = layout.shard_map(
-            lambda q_, k_, v_, t_, s_, ql_, cl_: kernel(
-                q_, k_, v_, t_, s_, ql_, cl_
-            ),
-            mesh=mesh,
-            in_specs=(
-                heads, lay.cache_block(), lay.cache_block(),
-                layout.spec(None, None), layout.spec(None),
-                layout.spec(None), layout.spec(None),
-            ),
-            out_specs=heads,
-        )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
+        if lks is not None:
+            out = layout.shard_map(
+                lambda q_, k_, v_, t_, s_, ql_, cl_, ks_, vs_: kernel(
+                    q_, k_, v_, t_, s_, ql_, cl_,
+                    k_scale=ks_, v_scale=vs_,
+                ),
+                mesh=mesh,
+                in_specs=(
+                    heads, lay.cache_block(), lay.cache_block(),
+                    layout.spec(None, None), layout.spec(None),
+                    layout.spec(None), layout.spec(None),
+                    lay.cache_scale_block(), lay.cache_scale_block(),
+                ),
+                out_specs=heads,
+            )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len,
+              lks, lvs)
+        else:
+            out = layout.shard_map(
+                lambda q_, k_, v_, t_, s_, ql_, cl_: kernel(
+                    q_, k_, v_, t_, s_, ql_, cl_
+                ),
+                mesh=mesh,
+                in_specs=(
+                    heads, lay.cache_block(), lay.cache_block(),
+                    layout.spec(None, None), layout.spec(None),
+                    layout.spec(None), layout.spec(None),
+                ),
+                out_specs=heads,
+            )(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
     else:
-        out = kernel(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len)
+        out = kernel(q_flat, lk, lv, block_tables, q_start, q_len, ctx_len,
+                     k_scale=lks, v_scale=lvs)
     return out.reshape(B, T, H, hd)
 
 
@@ -465,17 +559,22 @@ def forward(
     # copied out of xs and back into ys wholesale every step (profiled at
     # ~90 ms/step of pure copies for a 1B model on v5e). Weights stay
     # stacked [L, …]; the static per-layer slice is a read, not a copy.
+    kv_quant = quant.is_quantized(eng.kv_dtype)
     new_k: list = []
     new_v: list = []
+    new_ks: list = []
+    new_vs: list = []
     stacked = params["layers"]
     for li in range(cfg.num_layers):
-        p = {name: w[li] for name, w in stacked.items()}
+        p = _layer_slice(stacked, li)
         lk, lv = cache["k"][li], cache["v"][li]   # [NB, KV, bs, hd]
+        lks = cache["ks"][li] if kv_quant else None  # [NB, KV, bs] f32
+        lvs = cache["vs"][li] if kv_quant else None
 
         x = _rms_norm(h, p["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ p["wq"]).reshape(B, T, H, hd)
-        k = (x @ p["wk"]).reshape(B, T, KV, hd)
-        v = (x @ p["wv"]).reshape(B, T, KV, hd)
+        q = _mm(x, p["wq"]).reshape(B, T, H, hd)
+        k = _mm(x, p["wk"]).reshape(B, T, KV, hd)
+        v = _mm(x, p["wv"]).reshape(B, T, KV, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         if use_ring:
@@ -503,6 +602,15 @@ def forward(
             v_upd = jax.lax.with_sharding_constraint(v_upd, repl_pin)
             k_upd = jax.lax.with_sharding_constraint(k_upd, upd_pin)
             v_upd = jax.lax.with_sharding_constraint(v_upd, upd_pin)
+        if kv_quant:
+            # per-(token, head) scales: a token's stored bytes depend only
+            # on its own K/V, never on block placement — so spec-decode
+            # and chunked-prefill replays of the same tokens stay
+            # bit-exact regardless of which block a replay scatters to
+            k_upd, k_sc = quant.kv_quantize(k_upd, eng.kv_dtype)
+            v_upd, v_sc = quant.kv_quantize(v_upd, eng.kv_dtype)
+            lks = lks.at[scatter_block, :, scatter_off].set(k_sc)
+            lvs = lvs.at[scatter_block, :, scatter_off].set(v_sc)
         lk = lk.at[scatter_block, :, scatter_off].set(k_upd)
         lv = lv.at[scatter_block, :, scatter_off].set(v_upd)
 
@@ -524,11 +632,13 @@ def forward(
             )(q, k, v)
         elif use_pallas and T == 1:
             attn = _paged_decode_attention(
-                eng, mesh, q, lk, lv, block_tables, seq_lens
+                eng, mesh, q, lk, lv, block_tables, seq_lens,
+                lks=lks, lvs=lvs,
             )
         elif use_pallas:
             attn = _paged_ragged_attention(
-                eng, mesh, q, lk, lv, block_tables, q_len, ctx_len
+                eng, mesh, q, lk, lv, block_tables, q_len, ctx_len,
+                lks=lks, lvs=lvs,
             )
         else:
             # gather the full context for attention: [B, W*bs, KV, hd] with
@@ -543,8 +653,21 @@ def forward(
             ).reshape(B, W, KV, bs, hd).transpose(0, 1, 3, 2, 4).reshape(
                 B, W * bs, KV, hd
             )
+            if kv_quant:
+                ks_all = jnp.take(
+                    lks, block_tables.reshape(-1), axis=0
+                ).reshape(B, W, KV, bs).transpose(0, 1, 3, 2).reshape(
+                    B, W * bs, KV
+                )
+                vs_all = jnp.take(
+                    lvs, block_tables.reshape(-1), axis=0
+                ).reshape(B, W, KV, bs).transpose(0, 1, 3, 2).reshape(
+                    B, W * bs, KV
+                )
+                k_all = quant.kv_dequantize(k_all, ks_all, q.dtype)
+                v_all = quant.kv_dequantize(v_all, vs_all, q.dtype)
             attn = _attention(q, k_all, v_all, positions)
-        h = h + attn.reshape(B, T, H * hd) @ p["wo"]
+        h = h + _mm(attn.reshape(B, T, H * hd), p["wo"])
 
         x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
@@ -553,14 +676,17 @@ def forward(
             D = x.shape[-1]
             out = moe_ffn(
                 x.reshape(B * T, D),
-                p["w_router"], p["w_gate"], p["w_up"], p["w_down"],
+                p["w_router"],
+                _dequant_leaf(p["w_gate"], x.dtype),
+                _dequant_leaf(p["w_up"], x.dtype),
+                _dequant_leaf(p["w_down"], x.dtype),
                 top_k=cfg.num_experts_per_token,
                 capacity_factor=cfg.moe_capacity_factor,
             )
             h = h + out.reshape(B, T, D)
         else:
-            gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
-            up = (x @ p["w_up"]).astype(jnp.float32)
+            gate = jax.nn.silu(_mm(x, p["w_gate"]).astype(jnp.float32))
+            up = _mm(x, p["w_up"]).astype(jnp.float32)
             if use_ring:
                 # ring chunks run the MLP sequence-parallel: activations
                 # stay T-sharded, the (small) weights all-gather — pin the
@@ -572,14 +698,21 @@ def forward(
                 )
                 gate = jax.lax.with_sharding_constraint(gate, ff_pin)
                 up = jax.lax.with_sharding_constraint(up, ff_pin)
-            h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+            h = h + _mm((gate * up).astype(h.dtype), p["w_down"])
         if h_pin is not None:
             h = jax.lax.with_sharding_constraint(h, h_pin)
         new_k.append(lk)
         new_v.append(lv)
+        if kv_quant:
+            new_ks.append(lks)
+            new_vs.append(lvs)
 
     h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    return {"k": new_k, "v": new_v}, h
+    out_cache: Cache = {"k": new_k, "v": new_v}
+    if kv_quant:
+        out_cache["ks"] = new_ks
+        out_cache["vs"] = new_vs
+    return out_cache, h
 
 
 def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
@@ -587,6 +720,13 @@ def logits_fn(cfg: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
             else params["lm_head"])
     # bf16 x bf16 -> f32 on the MXU; casting the [D, V] head to f32 first
     # would materialise ~1 GB in HBM every step
+    if isinstance(head, dict):
+        y = jax.lax.dot_general(
+            h, head["q"].astype(h.dtype),
+            (((h.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return y * head["s"][0]
     return jax.lax.dot_general(
         h, head.astype(h.dtype), (((h.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -617,15 +757,15 @@ def encode_forward(
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
     stacked = params["layers"]
     for li in range(cfg.num_layers):
-        p = {name: w[li] for name, w in stacked.items()}
+        p = _layer_slice(stacked, li)
         x = _rms_norm(h, p["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ p["wq"]).reshape(B, T, H, hd)
-        k = (x @ p["wk"]).reshape(B, T, KV, hd)
-        v = (x @ p["wv"]).reshape(B, T, KV, hd)
+        q = _mm(x, p["wq"]).reshape(B, T, H, hd)
+        k = _mm(x, p["wk"]).reshape(B, T, KV, hd)
+        v = _mm(x, p["wv"]).reshape(B, T, KV, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         attn = _attention(q, k, v, positions)
-        h = h + attn.reshape(B, T, H * hd) @ p["wo"]
+        h = h + _mm(attn.reshape(B, T, H * hd), p["wo"])
         x = _rms_norm(h, p["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
             from ..parallel.moe import moe_ffn
@@ -633,15 +773,18 @@ def encode_forward(
             D = x.shape[-1]
             out = moe_ffn(
                 x.reshape(B * T, D),
-                p["w_router"], p["w_gate"], p["w_up"], p["w_down"],
+                p["w_router"],
+                _dequant_leaf(p["w_gate"], x.dtype),
+                _dequant_leaf(p["w_up"], x.dtype),
+                _dequant_leaf(p["w_down"], x.dtype),
                 top_k=cfg.num_experts_per_token,
                 capacity_factor=cfg.moe_capacity_factor,
             )
             h = h + out.reshape(B, T, D)
         else:
-            gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32))
-            up = (x @ p["w_up"]).astype(jnp.float32)
-            h = h + ((gate * up).astype(h.dtype) @ p["w_down"])
+            gate = jax.nn.silu(_mm(x, p["w_gate"]).astype(jnp.float32))
+            up = _mm(x, p["w_up"]).astype(jnp.float32)
+            h = h + _mm((gate * up).astype(h.dtype), p["w_down"])
     h = _rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
 
     valid = (positions >= 0).astype(jnp.float32)[:, :, None]  # [B, T, 1]
@@ -652,7 +795,8 @@ def encode_forward(
     return pooled / jnp.maximum(norm, 1e-12)
 
 
-def make_encode_fn(cfg: ModelConfig, mesh: Optional[Mesh] = None):
+def make_encode_fn(cfg: ModelConfig, mesh: Optional[Mesh] = None,
+                   weight_dtype: str = "bf16"):
     """Jitted encode step: (params, tokens[B,T], positions[B,T]) -> [B, D].
 
     ``mesh`` pins params to the canonical layout (pooled embeddings are
@@ -661,7 +805,9 @@ def make_encode_fn(cfg: ModelConfig, mesh: Optional[Mesh] = None):
     if _multi(mesh):
         lay = SpecLayout.for_mesh(mesh)
         repl = layout.replicated(mesh)
-        kw["in_shardings"] = (lay.param_shardings(mesh, cfg), repl, repl)
+        kw["in_shardings"] = (
+            lay.param_shardings(mesh, cfg, weight_dtype), repl, repl
+        )
         kw["out_shardings"] = repl
     return compilewatch.label(
         jax.jit(functools.partial(encode_forward, cfg), **kw), "encode"
@@ -850,7 +996,7 @@ def make_step_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Optional[Mesh]):
     return compilewatch.label(
         jax.jit(
             raw_step_fn(cfg, eng, mesh), donate_argnums=(1,),
-            **_io_kwargs(mesh, cfg, 9, ("cache", "repl")),
+            **_io_kwargs(mesh, cfg, 9, ("cache", "repl"), eng=eng),
         ),
         "step",
     )
@@ -942,7 +1088,7 @@ def make_decode_window_fn(cfg: ModelConfig, eng: EngineConfig, K: int,
     return compilewatch.label(
         jax.jit(
             raw_decode_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2),
-            **_io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl")),
+            **_io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl"), eng=eng),
         ),
         "ring_decode_window",
     )
@@ -1090,7 +1236,7 @@ def make_autopilot_fns(cfg: ModelConfig, eng: EngineConfig, K: int,
     window = compilewatch.label(
         jax.jit(
             raw_autopilot_window_fn(cfg, eng, K, mesh), donate_argnums=(1, 2),
-            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl")),
+            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl"), eng=eng),
         ),
         "decode_window",
     )
@@ -1236,7 +1382,7 @@ def make_spec_fns(cfg: ModelConfig, eng: EngineConfig, k: int,
         jax.jit(
             raw_spec_window_fn(cfg, eng, k, ngram_min, ngram_max, mesh),
             donate_argnums=(1, 2),
-            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl")),
+            **_io_kwargs(mesh, cfg, 2, ("cache", "repl", "repl"), eng=eng),
         ),
         "spec_window",
     )
@@ -1334,7 +1480,7 @@ def make_packed_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
         jax.jit(
             raw_packed_prefill_fn(cfg, eng, T, W, mesh),
             donate_argnums=(1, 2),
-            **_io_kwargs(mesh, cfg, 3, ("cache", "repl", "repl")),
+            **_io_kwargs(mesh, cfg, 3, ("cache", "repl", "repl"), eng=eng),
         ),
         f"packed_prefill_T{T}_W{W}",
     )
@@ -1347,7 +1493,7 @@ def make_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     """Jitted ring prefill; cache + ring donated. ``out_shardings``
     overrides the canonical output layout if a caller needs to (the sp
     path's defaults already pin the serving cache layout)."""
-    kw = _io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl"))
+    kw = _io_kwargs(mesh, cfg, 12, ("cache", "repl", "repl"), eng=eng)
     if out_shardings is not None:
         kw["out_shardings"] = out_shardings
     return compilewatch.label(
@@ -1388,7 +1534,7 @@ def make_mm_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     return compilewatch.label(
         jax.jit(
             step, donate_argnums=(1,),
-            **_io_kwargs(mesh, cfg, 11, ("cache", "repl")),
+            **_io_kwargs(mesh, cfg, 11, ("cache", "repl"), eng=eng),
         ),
         "mm_prefill",
     )
@@ -1423,7 +1569,7 @@ def make_mm_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig,
     return compilewatch.label(
         jax.jit(
             step, donate_argnums=(1, 2),
-            **_io_kwargs(mesh, cfg, 14, ("cache", "repl", "repl")),
+            **_io_kwargs(mesh, cfg, 14, ("cache", "repl", "repl"), eng=eng),
         ),
         "mm_ring_prefill",
     )
@@ -1445,7 +1591,7 @@ def make_sp_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
         jax.jit(
             raw_step_fn(cfg, eng, mesh, ring_mesh=mesh),
             donate_argnums=(1,),
-            **_io_kwargs(mesh, cfg, 9, ("cache", "repl")),
+            **_io_kwargs(mesh, cfg, 9, ("cache", "repl"), eng=eng),
         ),
         "sp_prefill",
     )
@@ -1466,10 +1612,19 @@ def make_sp_ring_prefill_fn(cfg: ModelConfig, eng: EngineConfig, mesh: Mesh):
 # the same jitted fns ride ICI when source and destination share a mesh.
 
 
+def cache_payload_keys(eng: EngineConfig) -> Tuple[str, ...]:
+    """The cache dict keys a block transfer must carry: quantized caches
+    add the per-(slot, head) scale planes to the K/V pages."""
+    if quant.is_quantized(eng.kv_dtype):
+        return ("k", "v", "ks", "vs")
+    return ("k", "v")
+
+
 def make_kv_ops(eng: EngineConfig, mesh: Optional[Mesh] = None):
     """(extract, inject) jitted block gather/scatter over the paged cache.
 
     extract(cache, block_ids[N]) -> {"k","v"}: [L, N, KV, bs, hd]
+    (plus {"ks","vs"}: [L, N, KV, bs] when the cache is quantized)
     inject(cache, block_ids[N], data) -> cache  (donated, in-place scatter)
 
     In the block-major layout these are single-axis gathers/scatters over
@@ -1479,27 +1634,32 @@ def make_kv_ops(eng: EngineConfig, mesh: Optional[Mesh] = None):
     the cache back to its serving layout, so the disagg handoff agrees
     with the cache about head placement on both ends.
     """
+    keys = cache_payload_keys(eng)
     kw_ex: Dict[str, Any] = {}
     kw_in: Dict[str, Any] = {}
     if _multi(mesh):
         lay = SpecLayout.for_mesh(mesh)
-        kw_ex["out_shardings"] = NamedSharding(mesh, lay.kv_blocks())
-        kw_in["out_shardings"] = NamedSharding(mesh, lay.cache_block())
+        kw_ex["out_shardings"] = layout.kv_payload_shardings(mesh, keys)
+        # inject returns the full per-layer cache dict: page layers pin to
+        # the cache layout, scale layers to the scale layout
+        page = NamedSharding(mesh, lay.cache_block())
+        scale = NamedSharding(mesh, lay.cache_scale_block())
+        kw_in["out_shardings"] = {
+            key: (scale if key in ("ks", "vs") else page) for key in keys
+        }
 
     def extract(cache: Cache, block_ids: jax.Array) -> Cache:
         return {
-            "k": jnp.stack([jnp.take(lk, block_ids, axis=0)
-                            for lk in cache["k"]]),
-            "v": jnp.stack([jnp.take(lv, block_ids, axis=0)
-                            for lv in cache["v"]]),
+            key: jnp.stack([jnp.take(layer, block_ids, axis=0)
+                            for layer in cache[key]])
+            for key in keys
         }
 
     def inject(cache: Cache, block_ids: jax.Array, data: Cache) -> Cache:
         return {
-            "k": [lk.at[block_ids].set(data["k"][li])
-                  for li, lk in enumerate(cache["k"])],
-            "v": [lv.at[block_ids].set(data["v"][li])
-                  for li, lv in enumerate(cache["v"])],
+            key: [layer.at[block_ids].set(data[key][li])
+                  for li, layer in enumerate(cache[key])]
+            for key in keys
         }
 
     return (

@@ -24,11 +24,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.logging import get_logger
+from . import quant
 from .config import ModelConfig
 
 log = get_logger("engine.weights")
 
 Params = Dict[str, Any]
+
+
+def _scale_shape(shape: tuple) -> tuple:
+    """Per-output-channel scale shape for a weight of ``shape``: the
+    contraction axis (-2) collapses to 1, ``keepdims`` style."""
+    return shape[:-2] + (1,) + shape[-1:]
 
 # stats from the most recent load_hf_params_sharded call (tests pin
 # peak_staging_bytes to one checkpoint tensor)
@@ -87,21 +94,38 @@ def _param_shapes(cfg: ModelConfig) -> Dict[str, Any]:
     return shapes
 
 
-def abstract_params(cfg: ModelConfig, mesh=None) -> Params:
+def abstract_params(cfg: ModelConfig, mesh=None,
+                    weight_dtype: str = "bf16") -> Params:
     """``jax.ShapeDtypeStruct`` tree for the param pytree — with a mesh,
     each leaf carries its ``SpecLayout`` NamedSharding, so orbax restores
-    (and the streaming HF loader) land directly on device shards."""
+    (and the streaming HF loader) land directly on device shards.  With a
+    quantized ``weight_dtype`` the matmul leaves become ``{"q", "s"}``
+    sub-trees (storage payload + float32 scales)."""
     import jax
 
     dt = jnp.dtype(cfg.dtype)
-    tree = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s, dt), _param_shapes(cfg),
-        is_leaf=lambda x: isinstance(x, tuple),
-    )
+    q_dt = quant.storage_dtype(weight_dtype) \
+        if quant.is_quantized(weight_dtype) else None
+
+    def _leaf(name: str, shape: tuple):
+        if q_dt is not None and quant.is_weight_leaf(name):
+            return {
+                "q": jax.ShapeDtypeStruct(shape, q_dt),
+                "s": jax.ShapeDtypeStruct(_scale_shape(shape), jnp.float32),
+            }
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    shapes = _param_shapes(cfg)
+    tree: Params = {
+        name: ({k: _leaf(k, s) for k, s in sub.items()}
+               if name == "layers" else _leaf(name, sub))
+        for name, sub in shapes.items()
+    }
     if mesh is not None and mesh.devices.size > 1:
         from ..parallel.layout import SpecLayout
 
-        shardings = SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
+        shardings = SpecLayout.for_mesh(mesh).param_shardings(
+            mesh, cfg, weight_dtype)
         tree = jax.tree.map(
             lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
             tree, shardings,
@@ -152,9 +176,16 @@ def _dest(cfg: ModelConfig, name: str):
     return None
 
 
-def load_hf_params(path: str, cfg: ModelConfig) -> Params:
+def load_hf_params(path: str, cfg: ModelConfig,
+                   weight_dtype: str = "bf16") -> Params:
     """Load an HF-format checkpoint directory (``*.safetensors``) into the
-    stacked scan param tree, cast to ``cfg.dtype``."""
+    stacked scan param tree, cast to ``cfg.dtype``.
+
+    With a quantized ``weight_dtype``, each matmul tensor is quantized in
+    numpy as it streams off the memory map — per-output-channel scales,
+    the ``engine.quant`` convention — so the stacked host buffers hold the
+    1-byte payload plus float32 scales, never a full-precision copy of a
+    quantized leaf."""
     from safetensors import safe_open
 
     path = Path(path)
@@ -162,11 +193,19 @@ def load_hf_params(path: str, cfg: ModelConfig) -> Params:
     if not files:
         raise FileNotFoundError(f"no *.safetensors under {path}")
     dt = _np_dtype(cfg.dtype)
+    quantized = quant.is_quantized(weight_dtype)
+    q_dt = quant.np_storage_dtype(weight_dtype) if quantized else None
+
+    def _buf(name: str, shape: tuple):
+        if quantized and quant.is_weight_leaf(name):
+            return {"q": np.zeros(shape, q_dt),
+                    "s": np.zeros(_scale_shape(shape), np.float32)}
+        return np.zeros(shape, dt)
 
     layers = {
-        k: np.zeros(shape, dt) for k, shape in _stacked_shapes(cfg).items()
+        k: _buf(k, shape) for k, shape in _stacked_shapes(cfg).items()
     }
-    top: Dict[str, np.ndarray] = {}
+    top: Dict[str, Any] = {}
     seen = set()
 
     for f in files:
@@ -183,6 +222,18 @@ def load_hf_params(path: str, cfg: ModelConfig) -> Params:
                     t = t.view(ml_dtypes.bfloat16)
                 if transpose:
                     t = t.T
+                if quantized and quant.is_weight_leaf(leaf):
+                    qd = quant.quantize_np(t, weight_dtype)
+                    if i is None:
+                        top[leaf] = qd
+                    elif e is None:
+                        layers[leaf]["q"][i] = qd["q"]
+                        layers[leaf]["s"][i] = qd["s"]
+                    else:
+                        layers[leaf]["q"][i, e] = qd["q"]
+                        layers[leaf]["s"][i, e] = qd["s"]
+                    seen.add((leaf, i, e))
+                    continue
                 t = t.astype(dt, copy=False)
                 if i is None:
                     top[leaf] = np.asarray(t)
@@ -199,14 +250,19 @@ def load_hf_params(path: str, cfg: ModelConfig) -> Params:
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = top["lm_head"]
-    log.info("loaded %d tensors from %s (%d files)",
-             len(seen), path, len(files))
-    return {k: jnp.asarray(v) if not isinstance(v, dict)
-            else {kk: jnp.asarray(vv) for kk, vv in v.items()}
-            for k, v in params.items()}
+    log.info("loaded %d tensors from %s (%d files, weight_dtype=%s)",
+             len(seen), path, len(files), weight_dtype)
+
+    def _dev(v):
+        if isinstance(v, dict):
+            return {kk: _dev(vv) for kk, vv in v.items()}
+        return jnp.asarray(v)
+
+    return {k: _dev(v) for k, v in params.items()}
 
 
-def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh) -> Params:
+def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh,
+                           weight_dtype: str = "bf16") -> Params:
     """Stream an HF safetensors checkpoint directly onto device shards.
 
     Each checkpoint tensor is staged on host exactly once — peak host
@@ -215,6 +271,11 @@ def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh) -> Params:
     buffer with a donated jitted ``.at[i].set``. The buffer keeps its
     ``SpecLayout`` layout throughout, so the engine can serve straight
     from the returned tree with zero resharding.
+
+    With a quantized ``weight_dtype`` each matmul tensor is quantized in
+    numpy right after staging (still one tensor peak), and the 1-byte
+    payload + float32 scales scatter into their own sharded buffers — the
+    full-precision tensor never reaches the device.
     """
     import jax
     from safetensors import safe_open
@@ -226,25 +287,38 @@ def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh) -> Params:
     if not files:
         raise FileNotFoundError(f"no *.safetensors under {path}")
     dt = _np_dtype(cfg.dtype)
-    shardings = SpecLayout.for_mesh(mesh).param_shardings(mesh, cfg)
+    quantized = quant.is_quantized(weight_dtype)
+    q_dt = jnp.dtype(quant.storage_dtype(weight_dtype)) if quantized else None
+    shardings = SpecLayout.for_mesh(mesh).param_shardings(
+        mesh, cfg, weight_dtype)
 
-    def _zeros(shape, sharding):
+    def _zeros(shape, sharding, buf_dt):
         return jax.jit(
-            lambda: jnp.zeros(shape, dt), out_shardings=sharding
+            lambda: jnp.zeros(shape, buf_dt), out_shardings=sharding
         )()
 
+    def _buf(name: str, shape: tuple):
+        sh = shardings["layers"][name]
+        if quantized and quant.is_weight_leaf(name):
+            return {
+                "q": _zeros(shape, sh["q"], q_dt),
+                "s": _zeros(_scale_shape(shape), sh["s"], jnp.float32),
+            }
+        return _zeros(shape, sh, dt)
+
     layers = {
-        k: _zeros(shape, shardings["layers"][k])
-        for k, shape in _stacked_shapes(cfg).items()
+        k: _buf(k, shape) for k, shape in _stacked_shapes(cfg).items()
     }
     top: Dict[str, Any] = {}
 
     setters: Dict[Any, Any] = {}
 
-    def _setter(leaf: str, with_expert: bool):
-        key = (leaf, with_expert)
+    def _setter(leaf: str, sub: Optional[str], with_expert: bool):
+        key = (leaf, sub, with_expert)
         if key not in setters:
             sh = shardings["layers"][leaf]
+            if sub is not None:
+                sh = sh[sub]
             if with_expert:
                 fn = lambda buf, i, e, t: buf.at[i, e].set(t)
             else:
@@ -270,16 +344,41 @@ def load_hf_params_sharded(path: str, cfg: ModelConfig, mesh) -> Params:
                     t = t.view(ml_dtypes.bfloat16)
                 if transpose:
                     t = t.T
+                if quantized and quant.is_weight_leaf(leaf):
+                    qd = quant.quantize_np(np.ascontiguousarray(t),
+                                           weight_dtype)
+                    # quantize_np stages one float32 copy of this tensor —
+                    # still a one-tensor peak, just at 4 bytes/elem
+                    peak = max(peak, int(t.size) * 4)
+                    if i is None:
+                        top[leaf] = {
+                            "q": jax.device_put(qd["q"],
+                                                shardings[leaf]["q"]),
+                            "s": jax.device_put(qd["s"],
+                                                shardings[leaf]["s"]),
+                        }
+                    elif e is None:
+                        layers[leaf]["q"] = _setter(leaf, "q", False)(
+                            layers[leaf]["q"], i, qd["q"])
+                        layers[leaf]["s"] = _setter(leaf, "s", False)(
+                            layers[leaf]["s"], i, qd["s"])
+                    else:
+                        layers[leaf]["q"] = _setter(leaf, "q", True)(
+                            layers[leaf]["q"], i, e, qd["q"])
+                        layers[leaf]["s"] = _setter(leaf, "s", True)(
+                            layers[leaf]["s"], i, e, qd["s"])
+                    n_seen += 1
+                    continue
                 t = np.ascontiguousarray(t.astype(dt, copy=False))
                 peak = max(peak, t.nbytes)
                 if i is None:
                     top[leaf] = jax.device_put(t, shardings[leaf])
                 elif e is None:
-                    layers[leaf] = _setter(leaf, False)(
+                    layers[leaf] = _setter(leaf, None, False)(
                         layers[leaf], i, t
                     )
                 else:
-                    layers[leaf] = _setter(leaf, True)(
+                    layers[leaf] = _setter(leaf, None, True)(
                         layers[leaf], i, e, t
                     )
                 n_seen += 1

@@ -3,9 +3,13 @@
 with hash reuse; storage/disk.rs for the disk tier).
 
 A block's payload is its per-block KV: ``{"k","v"}: [L, KV, bs, hd]``
-numpy arrays. G2 is an LRU dict bounded by ``capacity_blocks``; overflow
-spills to G3 (one file per block under ``disk_dir``) when configured,
-else drops. Lookups check G2 then G3 (disk hits are re-promoted to G2).
+numpy arrays — plus ``"ks"``/``"vs"`` float32 scales when the engine
+serves a quantized KV cache. G2 is an LRU dict bounded by
+``capacity_blocks`` AND (when ``capacity_bytes`` > 0) by total payload
+bytes — the byte bound is what lets an int8 cache hold ~2x the blocks of
+a bf16 cache in the same host budget. Overflow spills to G3 (one file per
+block under ``disk_dir``) when configured, else drops. Lookups check G2
+then G3 (disk hits are re-promoted to G2).
 """
 
 from __future__ import annotations
@@ -21,6 +25,14 @@ import numpy as np
 from ..utils.logging import get_logger
 
 log = get_logger("kvbm.host_pool")
+
+
+def _restore_dtype(name: str) -> np.dtype:
+    """Resolve a saved dtype name, reaching into ml_dtypes for the
+    numpy-foreign ones (bfloat16, float8_e4m3fn, ...)."""
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name, name))
 
 
 @dataclass
@@ -41,8 +53,12 @@ class HostBlockPool:
         capacity_blocks: int,
         disk_dir: Optional[str] = None,
         disk_capacity_blocks: int = 0,
+        capacity_bytes: int = 0,
     ):
         self.capacity = capacity_blocks
+        # 0 = unbounded; rides the incremental _mem_bytes accounting, so
+        # the bound is O(1) per put regardless of pool size
+        self.capacity_bytes = capacity_bytes
         self.disk_dir = Path(disk_dir) if disk_dir else None
         self.disk_capacity = disk_capacity_blocks if disk_dir else 0
         if self.disk_dir is not None:
@@ -70,15 +86,24 @@ class HostBlockPool:
         if path is not None:
             try:
                 with np.load(path) as z:
-                    data = {"k": z["k"], "v": z["v"]}
-                    # bfloat16 round-trips as uint16 views (np.savez can't
-                    # serialise ml_dtypes natively)
-                    dtype = str(z["dtype"]) if "dtype" in z else None
-                if dtype and dtype != data["k"].dtype.name:
-                    import ml_dtypes
-
-                    dt = np.dtype(getattr(ml_dtypes, dtype, dtype))
-                    data = {n: a.view(dt) for n, a in data.items()}
+                    if "__keys__" in z:
+                        # per-key payload + dtype (quantized caches mix
+                        # 1-byte pages with float32 scales)
+                        data = {}
+                        for key in [str(x) for x in z["__keys__"]]:
+                            a = z[key]
+                            dtype = str(z[f"{key}_dtype"])
+                            if dtype != a.dtype.name:
+                                a = a.view(_restore_dtype(dtype))
+                            data[key] = a
+                    else:  # legacy {"k","v"} single-dtype layout
+                        data = {"k": z["k"], "v": z["v"]}
+                        # bfloat16 round-trips as uint16 views (np.savez
+                        # can't serialise ml_dtypes natively)
+                        dtype = str(z["dtype"]) if "dtype" in z else None
+                        if dtype and dtype != data["k"].dtype.name:
+                            dt = _restore_dtype(dtype)
+                            data = {n: a.view(dt) for n, a in data.items()}
             except Exception:
                 log.exception("G3 read failed for %x", seq_hash)
                 self._disk.pop(seq_hash, None)
@@ -97,7 +122,11 @@ class HostBlockPool:
             return
         self._mem[seq_hash] = data
         self._mem_bytes += sum(a.nbytes for a in data.values())
-        while len(self._mem) > self.capacity:
+        while self._mem and (
+            len(self._mem) > self.capacity
+            or (self.capacity_bytes > 0
+                and self._mem_bytes > self.capacity_bytes)
+        ):
             old_hash, old_data = self._mem.popitem(last=False)
             self._mem_bytes -= sum(a.nbytes for a in old_data.values())
             self._spill(old_hash, old_data)
@@ -113,11 +142,16 @@ class HostBlockPool:
             return
         path = self.disk_dir / f"{seq_hash:016x}.npz"
         try:
-            k, v = data["k"], data["v"]
-            dtype = k.dtype.name
-            if k.dtype.kind not in "fiu":  # ml_dtypes (bfloat16 etc.)
-                k, v = k.view(np.uint16), v.view(np.uint16)
-            np.savez(path, k=k, v=v, dtype=dtype)
+            save: Dict[str, np.ndarray] = {
+                "__keys__": np.asarray(sorted(data.keys()))
+            }
+            for key, a in data.items():
+                save[f"{key}_dtype"] = np.asarray(a.dtype.name)
+                if a.dtype.kind not in "fiu":  # ml_dtypes (bf16, fp8 ...)
+                    a = a.view(np.uint16 if a.dtype.itemsize == 2
+                               else np.uint8)
+                save[key] = a
+            np.savez(path, **save)
         except Exception:
             log.exception("G3 spill failed for %x", seq_hash)
             if self.on_drop is not None:  # the block is gone — retract

@@ -31,7 +31,10 @@ log = get_logger("kvbm")
 
 @dataclass
 class KvbmConfig:
-    host_blocks: int = 1024          # G2 capacity
+    host_blocks: int = 1024          # G2 capacity (blocks)
+    host_bytes: int = 0              # G2 capacity (bytes; 0 = unbounded) —
+    # a byte bound sized to the host budget lets a quantized KV cache
+    # (int8/fp8, ~half the bytes per block) hold ~2x the blocks
     disk_dir: Optional[str] = None   # G3 location (None = no disk tier)
     disk_blocks: int = 0             # G3 capacity
     max_offload_per_tick: int = 32   # device-gather batch bound
@@ -103,6 +106,7 @@ class KvbmManager:
         self.host_pool = HostBlockPool(
             self.config.host_blocks, self.config.disk_dir,
             self.config.disk_blocks,
+            capacity_bytes=self.config.host_bytes,
         )
         self.remote = remote   # G4 tier (None = disabled)
         self.peers = None      # distributed peer-G2 plane (kvbm.distributed)
@@ -122,6 +126,10 @@ class KvbmManager:
             "drops_total": hs.drops,
             "offloaded_total": self.stats.offloaded_blocks,
             "onboarded_total": self.stats.onboarded_blocks,
+            "onboard_requests_total": self.stats.onboard_requests,
+            "g4_puts_total": self.stats.g4_puts,
+            "g4_hits_total": self.stats.g4_hits,
+            "peer_hits_total": self.stats.peer_hits,
         }
 
     # ---- pool event hook (called synchronously from the scheduler) ----
@@ -161,11 +169,9 @@ class KvbmManager:
         data = await self.engine.extract_kv_blocks(block_ids)
         for i, p in enumerate(batch):
             # copy each [L, KV, bs, hd] block out of the batched gather —
-            # a numpy view would pin the whole batch buffer in G2
-            block = {
-                "k": data["k"][:, i].copy(),
-                "v": data["v"][:, i].copy(),
-            }
+            # a numpy view would pin the whole batch buffer in G2.  A
+            # quantized cache adds "ks"/"vs" scale tensors to the payload.
+            block = {key: arr[:, i].copy() for key, arr in data.items()}
             self.host_pool.put(p.seq_hash, block)
             if self.remote is not None:
                 try:  # write-through to the cluster-shared G4 tier
@@ -240,8 +246,8 @@ class KvbmManager:
                 return 0
             block_ids = [bid for bid, _ in adopted]
             data = {
-                "k": np.stack([d["k"] for _, d in adopted], axis=1),
-                "v": np.stack([d["v"] for _, d in adopted], axis=1),
+                key: np.stack([d[key] for _, d in adopted], axis=1)
+                for key in adopted[0][1]
             }
             await self.engine.inject_kv_blocks(block_ids, data)
         except BaseException:

@@ -114,6 +114,25 @@ class MetricsAggregator:
             "kvbm_spills_total",
             "per-worker G2→G3 disk spills", ["worker"]
         )
+        self._g_kvbm_onboard_reqs = m.gauge(
+            "kvbm_onboard_requests_total",
+            "per-worker admissions that onboarded host-tier blocks",
+            ["worker"]
+        )
+        self._g_kvbm_g4_puts = m.gauge(
+            "kvbm_g4_puts_total",
+            "per-worker write-throughs to the cluster G4 tier", ["worker"]
+        )
+        self._g_kvbm_g4_hits = m.gauge(
+            "kvbm_g4_hits_total",
+            "per-worker blocks onboarded from the cluster G4 tier",
+            ["worker"]
+        )
+        self._g_kvbm_peer_hits = m.gauge(
+            "kvbm_peer_hits_total",
+            "per-worker blocks onboarded from a peer worker's G2 pool",
+            ["worker"]
+        )
         # preemption tolerance ("preempt" key): maintenance notices seen
         # and where the evacuated seats went
         self._g_preempt_notices = m.gauge(
@@ -231,6 +250,14 @@ class MetricsAggregator:
             kb.get("host_pool_bytes", 0.0))
         self._g_kvbm_spills.labels(worker=wid).set(
             kb.get("spills_total", 0.0))
+        self._g_kvbm_onboard_reqs.labels(worker=wid).set(
+            kb.get("onboard_requests_total", 0.0))
+        self._g_kvbm_g4_puts.labels(worker=wid).set(
+            kb.get("g4_puts_total", 0.0))
+        self._g_kvbm_g4_hits.labels(worker=wid).set(
+            kb.get("g4_hits_total", 0.0))
+        self._g_kvbm_peer_hits.labels(worker=wid).set(
+            kb.get("peer_hits_total", 0.0))
         pe = snap.get("preempt") or {}
         self._g_preempt_notices.labels(worker=wid).set(
             pe.get("notices", 0.0))
@@ -254,7 +281,9 @@ class MetricsAggregator:
                           self._g_pad_waste, self._g_dg_fallbacks,
                           self._g_dg_breaker, self._g_dg_retries,
                           self._g_dg_orphans, self._g_kvbm_bytes,
-                          self._g_kvbm_spills, self._g_preempt_notices,
+                          self._g_kvbm_spills, self._g_kvbm_onboard_reqs,
+                          self._g_kvbm_g4_puts, self._g_kvbm_g4_hits,
+                          self._g_kvbm_peer_hits, self._g_preempt_notices,
                           self._g_preempt_evacuated):
                 gauge.remove(worker=wid)
             log.info("expired stale worker %s from the scrape", wid)

@@ -31,7 +31,22 @@ PEAK_FLOPS = {
     "v6 lite": 918e12,
     "v6e": 918e12,
 }
-DEFAULT_PEAK = 197e12  # v5e — the BASELINE.md target platform
+# Peak dense int8 OP/s per chip. v5e/v5p/v6e double the bf16 MXU rate on
+# 8-bit inputs; v4 predates the int8 path and stays at its bf16 number.
+PEAK_FLOPS_INT8 = {
+    "v4": 275e12,
+    "v5 lite": 394e12,
+    "v5e": 394e12,
+    "v5p": 918e12,
+    "v5": 918e12,
+    "v6 lite": 1836e12,
+    "v6e": 1836e12,
+}
+# fp8 rides the same 8-bit MXU datapath as int8 on the generations that
+# have it (MFU with quantized weights is measured against this roofline).
+PEAK_FLOPS_FP8 = PEAK_FLOPS_INT8
+DEFAULT_PEAK = 197e12        # v5e — the BASELINE.md target platform
+DEFAULT_PEAK_INT8 = 394e12   # v5e 8-bit rate
 CPU_PEAK = 1e12        # nominal, so CPU-fallback MFU fields stay defined
 
 
@@ -41,14 +56,18 @@ def peak_flops(device_kind: str, platform: str,
 
     Longest-key match over the table; unknown TPU kinds fall back to the
     v5e number, non-TPU platforms to the nominal CPU peak. fp32 halves a
-    TPU's MXU rate (bf16 inputs are the spec-sheet number)."""
+    TPU's MXU rate; ``"int8"``/``"fp8"`` select the doubled 8-bit table
+    (bf16 inputs are the spec-sheet number)."""
     if platform != "tpu":
         return CPU_PEAK
     kind = (device_kind or "").lower()
-    peak = DEFAULT_PEAK
-    for key in sorted(PEAK_FLOPS, key=len, reverse=True):
+    if dtype in ("int8", "fp8", "float8_e4m3fn"):
+        table, peak = PEAK_FLOPS_INT8, DEFAULT_PEAK_INT8
+    else:
+        table, peak = PEAK_FLOPS, DEFAULT_PEAK
+    for key in sorted(table, key=len, reverse=True):
         if key in kind:
-            peak = PEAK_FLOPS[key]
+            peak = table[key]
             break
     if dtype in ("float32", "f32"):
         peak /= 2.0

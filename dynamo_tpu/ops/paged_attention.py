@@ -62,16 +62,19 @@ def _ragged_kernel(
     q_ref,         # [KV, TQ, G, hd]
     k_ref,         # [1, KV, kv_tile, hd]
     v_ref,         # [1, KV, kv_tile, hd]
-    o_ref,         # [KV, TQ, G, hd]
-    # scratch
-    m_ref,         # [KV, TQ*G, 1] f32 running max
-    l_ref,         # [KV, TQ*G, 1] f32 running denominator
-    acc_ref,       # [KV, TQ*G, hd] f32 running numerator
-    *,
+    # quantized kv_dtype adds two scale blocks here: ks_ref/vs_ref
+    # [1, KV, kv_tile] f32 (see *rest unpacking below)
+    *rest,
     kv_tile: int,
     q_tile: int,
     scale: float,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     r = pl.program_id(0)
     t = pl.program_id(1)
     w = pl.program_id(2)
@@ -103,6 +106,13 @@ def _ragged_kernel(
         q = q_ref[...].astype(jnp.float32).reshape(KV, TQ * G, hd)
         k = k_ref[0].astype(jnp.float32)                 # [KV, bs, hd]
         v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            # quantized pages: dequantize with the per-(slot, head) scales
+            # BEFORE the trash-slot zeroing below, so arbitrary bits in the
+            # trash block's scale rows (NaN included) are wiped by the same
+            # jnp.where that wipes the page payload.
+            k = k * ks_ref[0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0].astype(jnp.float32)[..., None]
         # keys at positions >= ctx_len live in the trash block / a stale
         # table tail — their bits are arbitrary (NaN included).  Zero them
         # BEFORE the MXU: -inf score masking alone still lets NaN·0 leak
@@ -176,6 +186,8 @@ def paged_attention_ragged(
     q_tile: int = 0,
     kv_tile: int = 0,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # [num_blocks, KV, bs] f32
+    v_scale: jax.Array | None = None,  # [num_blocks, KV, bs] f32
 ) -> jax.Array:
     """Ragged paged attention over heterogeneous-length query rows.
 
@@ -198,7 +210,19 @@ def paged_attention_ragged(
     *more* than one block — tuning upward means growing ``block_size``
     itself, a cache-layout change the autotuner only ever recommends).
     ``0`` means the default (``min(max_q_len, 128)`` / ``block_size``).
+
+    Quantized KV (``EngineConfig.kv_dtype`` int8/fp8): pass the per-(slot,
+    head) float32 scale caches as ``k_scale``/``v_scale`` — the kernel
+    dequantizes each K/V tile inside the launch (one multiply before the
+    MXU), with the scales riding two extra block inputs whose index map is
+    the 3-tuple analogue of the page ``kv_map`` (same trash-block routing,
+    so skipped steps DMA block 0's scales and the in-kernel zeroing wipes
+    them along with the payload).  ``None`` (the default) traces the exact
+    unquantized kernel — byte-identical to the pre-quant path.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    quantized = k_scale is not None
     Tq, H, hd = q.shape
     KV = k_cache.shape[1]
     G = H // KV
@@ -238,14 +262,28 @@ def paged_attention_ragged(
         use = live & (w * kv_tile <= ctx_len[r] - q_len[r] + last_q)
         return (jnp.where(use, tables[r, w // splits], 0), 0, w % splits, 0)
 
+    def scale_map(r, t, w, q_start, q_len, ctx_len, tables):
+        # 3-tuple twin of kv_map for the [num_blocks, KV, bs] scale caches
+        block, _, sub, _ = kv_map(r, t, w, q_start, q_len, ctx_len, tables)
+        return (block, 0, sub)
+
+    in_specs = [
+        pl.BlockSpec((KV, q_tile, G, hd), q_map),
+        pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
+        pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
+    ]
+    operands = [q4, k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, KV, kv_tile), scale_map),
+            pl.BlockSpec((1, KV, kv_tile), scale_map),
+        ]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(R, num_t, W * splits),
-        in_specs=[
-            pl.BlockSpec((KV, q_tile, G, hd), q_map),
-            pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
-            pl.BlockSpec((1, KV, kv_tile, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((KV, q_tile, G, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((KV, q_tile * G, 1), jnp.float32),
@@ -256,14 +294,14 @@ def paged_attention_ragged(
 
     kernel = functools.partial(
         _ragged_kernel, kv_tile=kv_tile, q_tile=q_tile,
-        scale=1.0 / (hd ** 0.5),
+        scale=1.0 / (hd ** 0.5), quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((KV, Tq, G, hd), q.dtype),
         interpret=interpret,
-    )(q_start, q_len, ctx_len, block_tables, q4, k_cache, v_cache)
+    )(q_start, q_len, ctx_len, block_tables, *operands)
     return out.transpose(1, 0, 2, 3).reshape(Tq, H, hd)
 
 
@@ -280,13 +318,16 @@ def paged_attention_decode(
     block_size: int,
     kv_tile: int = 0,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Single-token-per-sequence paged attention.  Returns ``[B, H, hd]``.
 
     The decode face of the ragged kernel: every row is one query slot
     (``q_tile == 1``).  ``seq_lens[b]`` counts the valid context slots for
     row ``b`` *including* the token being decoded; ``seq_lens[b] == 0``
-    rows emit exact zeros.
+    rows emit exact zeros.  ``k_scale``/``v_scale`` carry quantized-KV
+    dequant scales exactly as in :func:`paged_attention_ragged`.
     """
     B = q.shape[0]
     q_start = jnp.arange(B + 1, dtype=jnp.int32)
@@ -294,5 +335,5 @@ def paged_attention_decode(
     return paged_attention_ragged(
         q, k_cache, v_cache, block_tables, q_start, q_len, seq_lens,
         block_size=block_size, max_q_len=1, q_tile=1, kv_tile=kv_tile,
-        interpret=interpret,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
     )

@@ -292,37 +292,61 @@ class SpecLayout:
     def lm_head(self) -> PartitionSpec:
         return spec(self.fsdp, self.tp)
 
-    def param_specs(self, cfg) -> Dict[str, Any]:
-        """PartitionSpec tree matching ``model.init_params(cfg)``."""
+    @staticmethod
+    def scale_spec(weight_spec: PartitionSpec) -> PartitionSpec:
+        """Per-channel quantization scales ride the weight's shape with the
+        contraction axis (-2) reduced to size 1 (``keepdims``), so the
+        scale's spec is the weight's with that entry replicated — sharding
+        a singleton dim over a real axis is indivisible."""
+        entries = list(weight_spec)
+        entries[-2] = None
+        return spec(*entries)
+
+    def param_specs(self, cfg, weight_dtype: str = "bf16"
+                    ) -> Dict[str, Any]:
+        """PartitionSpec tree matching ``model.init_params(cfg)``; with a
+        quantized ``weight_dtype`` each matmul leaf becomes a
+        ``{"q": weight_spec, "s": scale_spec}`` dict mirroring the
+        quantized param pytree (engine/quant.py)."""
+        from ..engine import quant
+
+        def w(s: PartitionSpec, name: str) -> Any:
+            if quant.is_quantized(weight_dtype) and quant.is_weight_leaf(
+                    name):
+                return {"q": s, "s": self.scale_spec(s)}
+            return s
+
         layers: Dict[str, Any] = {
             "attn_norm": self.norm_stacked(),
-            "wq": self.column_stacked(),
-            "wk": self.column_stacked(),
-            "wv": self.column_stacked(),
-            "wo": self.row_stacked(),
+            "wq": w(self.column_stacked(), "wq"),
+            "wk": w(self.column_stacked(), "wk"),
+            "wv": w(self.column_stacked(), "wv"),
+            "wo": w(self.row_stacked(), "wo"),
             "mlp_norm": self.norm_stacked(),
         }
         if cfg.is_moe:
             layers["w_router"] = self.router_stacked()
-            layers["w_gate"] = self.expert_stacked()
-            layers["w_up"] = self.expert_stacked()
-            layers["w_down"] = self.expert_stacked()
+            layers["w_gate"] = w(self.expert_stacked(), "w_gate")
+            layers["w_up"] = w(self.expert_stacked(), "w_up")
+            layers["w_down"] = w(self.expert_stacked(), "w_down")
         else:
-            layers["w_gate"] = self.column_stacked()
-            layers["w_up"] = self.column_stacked()
-            layers["w_down"] = self.row_stacked()
+            layers["w_gate"] = w(self.column_stacked(), "w_gate")
+            layers["w_up"] = w(self.column_stacked(), "w_up")
+            layers["w_down"] = w(self.row_stacked(), "w_down")
         specs: Dict[str, Any] = {
             "embed": self.embed(),
             "layers": layers,
             "final_norm": self.norm(),
         }
         if not cfg.tie_word_embeddings:
-            specs["lm_head"] = self.lm_head()
+            specs["lm_head"] = w(self.lm_head(), "lm_head")
         return specs
 
-    def param_shardings(self, mesh: Mesh, cfg) -> Dict[str, Any]:
+    def param_shardings(self, mesh: Mesh, cfg,
+                        weight_dtype: str = "bf16") -> Dict[str, Any]:
         return jax.tree.map(
-            functools.partial(NamedSharding, mesh), self.param_specs(cfg),
+            functools.partial(NamedSharding, mesh),
+            self.param_specs(cfg, weight_dtype),
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
@@ -333,21 +357,45 @@ class SpecLayout:
         each chip holds exactly the heads it computes."""
         return spec(None, self.tp, None, None)
 
-    def cache_specs(self, cfg) -> Dict[str, Any]:
-        return {
+    def cache_scale_block(self) -> PartitionSpec:
+        """Per-layer KV-scale cache [NB, KV, bs] (quantized kv_dtype):
+        heads over tp, matching :meth:`cache_block` minus the hd axis."""
+        return spec(None, self.tp, None)
+
+    def cache_specs(self, cfg, kv_dtype: str = "bf16") -> Dict[str, Any]:
+        from ..engine import quant
+
+        specs = {
             "k": [self.cache_block()] * cfg.num_layers,
             "v": [self.cache_block()] * cfg.num_layers,
         }
+        if quant.is_quantized(kv_dtype):
+            specs["ks"] = [self.cache_scale_block()] * cfg.num_layers
+            specs["vs"] = [self.cache_scale_block()] * cfg.num_layers
+        return specs
 
-    def cache_shardings(self, mesh: Mesh, cfg) -> Dict[str, Any]:
+    def cache_shardings(self, mesh: Mesh, cfg,
+                        kv_dtype: str = "bf16") -> Dict[str, Any]:
+        from ..engine import quant
+
         s = NamedSharding(mesh, self.cache_block())
-        return {"k": [s] * cfg.num_layers, "v": [s] * cfg.num_layers}
+        out = {"k": [s] * cfg.num_layers, "v": [s] * cfg.num_layers}
+        if quant.is_quantized(kv_dtype):
+            ss = NamedSharding(mesh, self.cache_scale_block())
+            out["ks"] = [ss] * cfg.num_layers
+            out["vs"] = [ss] * cfg.num_layers
+        return out
 
     def kv_blocks(self) -> PartitionSpec:
         """Extracted/injected KV block payload [L, N, KV, bs, hd] — the
         disagg transfer layout; KV heads carry tp exactly like the cache,
         so a P->D handoff between equal-TP meshes never reshards."""
         return spec(None, None, self.tp, None, None)
+
+    def kv_scale_blocks(self) -> PartitionSpec:
+        """Scale payload [L, N, KV, bs] riding the block transfer when the
+        cache is quantized; tp on the heads like :meth:`kv_blocks`."""
+        return spec(None, None, self.tp, None)
 
     def hidden(self) -> PartitionSpec:
         """Dense-path activations [B, T, D]: replicated (the Megatron
@@ -372,3 +420,13 @@ class SpecLayout:
 def kv_blocks_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a KV block-transfer payload landing on ``mesh``."""
     return NamedSharding(mesh, SpecLayout.for_mesh(mesh).kv_blocks())
+
+
+def kv_payload_shardings(mesh: Mesh, keys) -> Dict[str, NamedSharding]:
+    """Per-key shardings for a KV block-transfer payload dict: ``k``/``v``
+    pages get :meth:`SpecLayout.kv_blocks`, ``ks``/``vs`` scale planes get
+    :meth:`SpecLayout.kv_scale_blocks`."""
+    lay = SpecLayout.for_mesh(mesh)
+    page = NamedSharding(mesh, lay.kv_blocks())
+    scale = NamedSharding(mesh, lay.kv_scale_blocks())
+    return {k: (scale if k in ("ks", "vs") else page) for k in keys}

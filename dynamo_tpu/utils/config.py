@@ -104,6 +104,12 @@ class RuntimeConfig:
     # running decodes (0 = whole-bucket prefill); see
     # EngineConfig.prefill_chunk_tokens
     prefill_chunk_tokens: int = 0
+    # -- quantized serving (engine/quant.py) --
+    # "bf16" | "int8" | "fp8": weight storage dtype (per-channel scales)
+    # and paged-KV storage dtype (per-token scales); validated again by
+    # EngineConfig at engine startup so a typo rejects before load
+    weight_dtype: str = "bf16"
+    kv_dtype: str = "bf16"
     # -- SLA planner (python -m dynamo_tpu.planner) --
     # latency statistic the SLAs are enforced on: "p99" | "p50" | "avg"
     planner_sla_quantile: str = "p99"
@@ -240,6 +246,10 @@ class RuntimeConfig:
         cfg.prefill_chunk_tokens = env_int(
             ENV_PREFIX + "PREFILL_CHUNK_TOKENS", cfg.prefill_chunk_tokens
         )
+        cfg.weight_dtype = env_str(
+            ENV_PREFIX + "WEIGHT_DTYPE", cfg.weight_dtype
+        )
+        cfg.kv_dtype = env_str(ENV_PREFIX + "KV_DTYPE", cfg.kv_dtype)
         cfg.planner_sla_quantile = env_str(
             ENV_PREFIX + "PLANNER_SLA_QUANTILE", cfg.planner_sla_quantile
         )

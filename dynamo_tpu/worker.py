@@ -77,6 +77,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="decode attention path; 'auto' probes both on the "
                         "live backend at startup and picks per-shape-class "
                         "winners (decode / spec window / prefill chunk)")
+    p.add_argument("--weight-dtype", default=None,
+                   choices=["bf16", "int8", "fp8"],
+                   help="weight storage dtype: int8/fp8 quantize at load "
+                        "time with per-channel scales "
+                        "(default: DYNTPU_WEIGHT_DTYPE, bf16)")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=["bf16", "int8", "fp8"],
+                   help="paged-KV storage dtype: int8/fp8 halve KV bytes "
+                        "per token with per-token scales "
+                        "(default: DYNTPU_KV_DTYPE, bf16)")
     p.add_argument("--prefill-chunk-tokens", type=int, default=None,
                    help="cap each prefill chunk at this many tokens so long "
                         "prompts interleave with running decodes instead of "
@@ -103,6 +113,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--disagg-queue-name", default="prefill_queue")
     p.add_argument("--kvbm-host-blocks", type=int, default=0,
                    help="G2 host-tier capacity in blocks (0 = KVBM off)")
+    p.add_argument("--kvbm-host-bytes", type=int, default=0,
+                   help="G2 host-tier capacity in bytes (0 = unbounded); "
+                        "byte-bounding lets a quantized (int8/fp8) KV "
+                        "cache hold ~2x the blocks in the same budget")
     p.add_argument("--kvbm-disk-dir", default=None)
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
     p.add_argument("--kvbm-remote", action="store_true",
@@ -164,6 +178,10 @@ async def run_worker(args: argparse.Namespace) -> None:
 
     dp, tp = (int(x) for x in args.mesh.split(","))
     model_cfg = MODEL_PRESETS[args.model]()
+    weight_dtype = (args.weight_dtype if args.weight_dtype is not None
+                    else config.weight_dtype)
+    kv_dtype = (args.kv_dtype if args.kv_dtype is not None
+                else config.kv_dtype)
     params = None
     if args.weights:
         from .engine.weights import (
@@ -179,9 +197,10 @@ async def run_worker(args: argparse.Namespace) -> None:
             from .engine import model as model_lib
 
             mesh = model_lib.make_mesh((dp, tp), jax.devices())
-            params = load_hf_params_sharded(args.weights, model_cfg, mesh)
+            params = load_hf_params_sharded(
+                args.weights, model_cfg, mesh, weight_dtype)
         else:
-            params = load_hf_params(args.weights, model_cfg)
+            params = load_hf_params(args.weights, model_cfg, weight_dtype)
         if args.tokenizer is None:
             args.tokenizer = args.weights
     eng_cfg = EngineConfig(
@@ -204,6 +223,8 @@ async def run_worker(args: argparse.Namespace) -> None:
         spec_k=(args.spec_k if args.spec_k is not None else config.spec_k),
         spec_auto_disable_threshold=config.spec_auto_disable_threshold,
         spec_auto_disable_window=config.spec_auto_disable_window,
+        weight_dtype=weight_dtype,
+        kv_dtype=kv_dtype,
     )
     tokenizer = load_tokenizer(args.tokenizer)
     name = args.model_name or args.model
@@ -273,6 +294,7 @@ async def run_worker(args: argparse.Namespace) -> None:
             )
         engine.attach_kvbm(KvbmConfig(
             host_blocks=args.kvbm_host_blocks,
+            host_bytes=args.kvbm_host_bytes,
             disk_dir=args.kvbm_disk_dir,
             disk_blocks=args.kvbm_disk_blocks,
         ), remote=remote)

@@ -36,6 +36,12 @@
 #                                 # watchdog, pressure ladder, chaos storms;
 #                                 # echoes the repro seed
 #                                 # (DYNTPU_CHAOS_SEED=<n>) on failure
+#   scripts/verify.sh quant       # quantized serving suite: int8/fp8 weight
+#                                 # + KV quantization (bf16 byte-parity,
+#                                 # per-dtype logprob budgets, kernel parity
+#                                 # with NaN trash blocks, kvbm/disagg
+#                                 # round-trips); echoes the repro line on
+#                                 # failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -68,6 +74,17 @@ if [ "${1:-}" = "mesh" ]; then
         echo "mesh parity FAILED; reproduce with:"
         echo "  XLA_FLAGS=--xla_force_host_platform_device_count=8 \\"
         echo "    JAX_PLATFORMS=cpu python -m pytest tests/ -m mesh"
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "quant" ]; then
+    rc=0
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant \
+        -p no:cacheprovider || rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "quantized serving suite FAILED; reproduce with:"
+        echo "  JAX_PLATFORMS=cpu python -m pytest tests/test_quantized.py -m quant"
     fi
     exit $rc
 fi

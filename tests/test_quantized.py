@@ -1,0 +1,633 @@
+"""Quantized serving: int8/fp8 weights + quantized paged KV cache.
+
+Pins the quality and compatibility contract of the quantization plumbing:
+
+* bf16 passthrough is byte-identical to the pre-quant path (same params
+  object, same cache structure, same engine token streams);
+* quant-on logprob divergence stays inside a per-dtype budget over mixed
+  ragged batches (the CPU-runnable quality harness);
+* the quantized Pallas kernel is BITWISE identical to dequantize-then-run
+  on all shape classes, including NaN-poisoned trash blocks and partial
+  blocks (the trash-block contract: masked quantized K/V still emit exact
+  zeros, never NaN);
+* kvbm offload→onboard and the disagg wire protocol round-trip quantized
+  payloads (pages + float32 scales) bit-exactly, dtype preserved;
+* spec-decode and chunked-prefill byte-parity invariants still hold with
+  quantization ON at matched seeds;
+* the G2 host pool byte cap doubles int8 residency; the aggregator
+  forward-compat gauges zero-default.
+
+All CPU (interpret-mode Pallas where a kernel is involved).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import model as model_lib
+from dynamo_tpu.engine import quant
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.kvbm.host_pool import HostBlockPool
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_decode, paged_attention_ragged,
+)
+
+pytestmark = pytest.mark.quant
+
+MC = ModelConfig.tiny(512)
+
+# measured on the tiny model (mixed ragged batch, CPU): int8 combos peak
+# around 0.08 nats, fp8 around 0.35 — budgets leave ~3x headroom without
+# letting a broken dequant path (O(1)+ divergence) slip through
+LOGPROB_BUDGET = {"int8": 0.25, "fp8": 0.80}
+
+
+def make_cfg(weight_dtype="bf16", kv_dtype="bf16", **kw) -> EngineConfig:
+    base = dict(
+        block_size=16, num_blocks=128, max_num_seqs=4,
+        max_num_batched_tokens=256, max_model_len=256,
+        prefill_buckets=(64, 256), decode_buckets=(4, 8),
+        attention_impl="einsum",
+        weight_dtype=weight_dtype, kv_dtype=kv_dtype,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# --------------------------- numpy primitives -----------------------------
+
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_weight_quantize_roundtrip(dtype):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((32, 16)).astype(np.float32)
+    w[:, 3] = 0.0  # an all-zero output channel must not divide by zero
+    q = quant.quantize_np(w, dtype)
+    assert set(q) == {"q", "s"}
+    assert q["q"].dtype == quant.np_storage_dtype(dtype)
+    assert q["s"].dtype == np.float32 and q["s"].shape == (1, 16)
+    back = quant.dequantize_np(q)
+    assert np.isfinite(back).all()
+    # per-channel scaling: error bounded by half a quantization step
+    step = np.max(np.abs(w), axis=0, keepdims=True) / quant.QMAX[dtype]
+    tol = step if dtype == "int8" else step * 16  # fp8: 3 mantissa bits
+    assert (np.abs(back - w) <= tol + 1e-7).all()
+
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_kv_quantize_per_token(dtype):
+    """A token's quantized bytes depend only on its own K/V — the property
+    spec-decode rollback and chunked-prefill replay parity rest on."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 4, 16)), jnp.float32)
+    q_all, s_all = quant.kv_quantize(x, dtype)
+    q_sub, s_sub = quant.kv_quantize(x[2:5], dtype)
+    np.testing.assert_array_equal(np.asarray(q_all[2:5]), np.asarray(q_sub))
+    np.testing.assert_array_equal(np.asarray(s_all[2:5]), np.asarray(s_sub))
+
+
+def test_bf16_passthrough_identity():
+    """weight_dtype="bf16" must leave the param tree untouched (same
+    object) and the cache structure scale-free — the byte-parity guarantee
+    that the quant plumbing costs nothing when off."""
+    params = model_lib.init_params(jax.random.PRNGKey(0), MC)
+    assert quant.quantize_params(params, "bf16") is params
+    cache = model_lib.init_cache(MC, make_cfg())
+    assert set(cache) == {"k", "v"}
+    qcache = model_lib.init_cache(MC, make_cfg(kv_dtype="int8"))
+    assert set(qcache) == {"k", "v", "ks", "vs"}
+    assert qcache["k"][0].dtype == jnp.int8
+    assert qcache["ks"][0].dtype == jnp.float32
+
+
+def test_quantized_cache_capacity():
+    """The point of the PR: at the same block count the quantized paged
+    cache costs ~half the HBM of bf16, i.e. 2x the blocks fit in the same
+    budget (pages halve exactly; scales add 4/head_dim per element)."""
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=4, num_kv_heads=4, head_dim=64,
+        max_position=512, rope_theta=10000.0, dtype="bfloat16",
+    )
+    eng16 = make_cfg()
+    eng8 = make_cfg(kv_dtype="int8")
+
+    def cache_bytes(eng):
+        c = model_lib.init_cache(cfg, eng)
+        return sum(a.nbytes for lst in c.values() for a in lst)
+
+    def page_bytes(eng):
+        c = model_lib.init_cache(cfg, eng)
+        return sum(a.nbytes for key in ("k", "v") for a in c[key])
+
+    assert page_bytes(eng8) * 2 == page_bytes(eng16)
+    # scales included, 2x blocks still undercut the bf16 budget + 13%
+    eng8_2x = make_cfg(kv_dtype="int8",
+                       num_blocks=eng16.num_blocks * 2)
+    assert cache_bytes(eng8_2x) <= cache_bytes(eng16) * 1.13
+
+
+# ------------------------- config / env knobs -----------------------------
+
+
+def test_engine_config_rejects_bad_dtype():
+    with pytest.raises(ValueError, match="weight_dtype"):
+        make_cfg(weight_dtype="int4")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        make_cfg(kv_dtype="e5m2")
+    with pytest.raises(ValueError, match="pp_stages"):
+        EngineConfig(weight_dtype="int8", pp_stages=2)
+
+
+def test_runtime_config_env_knobs(monkeypatch):
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    monkeypatch.setenv("DYNTPU_WEIGHT_DTYPE", "int8")
+    monkeypatch.setenv("DYNTPU_KV_DTYPE", "fp8")
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.weight_dtype == "int8"
+    assert cfg.kv_dtype == "fp8"
+
+
+def test_peak_flops_quant_roofline():
+    from dynamo_tpu.observability.flops import peak_flops
+
+    assert peak_flops("TPU v5e", "tpu", "int8") == 394e12
+    assert peak_flops("TPU v5e", "tpu", "fp8") == 394e12
+    assert peak_flops("TPU v6e", "tpu", "int8") == 1836e12
+    # v4 has no 8-bit MXU boost; bf16 stays the bf16 table
+    assert peak_flops("TPU v4", "tpu", "int8") == 275e12
+    assert peak_flops("TPU v5e", "tpu") == 197e12
+
+
+# -------------------- kernel parity (interpret mode) ----------------------
+
+
+def _quantized_kernel_case(seed, B, T, W, bs, kv_dtype, partial=True):
+    """Random ragged case + per-token-quantized caches, trash block (0)
+    NaN-poisoned the way a served cache would be garbage: scale NaN, and
+    for fp8 the payload too (int8 has no NaN encoding)."""
+    rng = np.random.default_rng(seed)
+    H, KV, hd = 4, 2, 32
+    NB = 1 + B * W
+    kc = rng.standard_normal((NB, KV, bs, hd)).astype(np.float32)
+    vc = rng.standard_normal((NB, KV, bs, hd)).astype(np.float32)
+    kq, ks = quant.kv_quantize_cache_np(kc, kv_dtype)
+    vq, vs = quant.kv_quantize_cache_np(vc, kv_dtype)
+    # the dequantized reference caches MUST come from the quantized bytes
+    # (bitwise parity is against dequantize-then-run, not the original)
+    k_ref = quant.kv_dequantize_cache_np(kq, ks)
+    v_ref = quant.kv_dequantize_cache_np(vq, vs)
+    # poison the trash block AFTER building the reference caches...
+    ks[0] = np.nan
+    vs[0] = np.nan
+    if kv_dtype == "fp8":
+        kq[0] = np.nan
+        vq[0] = np.nan
+    # ...and mirror NaN into the reference trash block so both paths see
+    # equally-poisoned masked data
+    k_ref[0] = np.nan
+    v_ref[0] = np.nan
+    tables = 1 + np.arange(B * W).reshape(B, W).astype(np.int32)
+    # row 0's LAST table slot is unallocated lookahead → trash block; its
+    # ctx stops before that slot, so the trash reference is always masked
+    # (the contract — valid context never points at block 0)
+    tables[0, W - 1] = 0
+    q = rng.standard_normal((B * T, H, hd)).astype(np.float32)
+    q_start = (np.arange(B + 1) * T).astype(np.int32)
+    if partial:
+        # ragged: row 0 ends mid-block, one dead row, one short row
+        ctx = np.array([bs * (W - 2) + 3, bs * W, bs + 5][:B], np.int32)
+        q_len = np.array([3, 0, T][:B], np.int32)
+    else:
+        ctx = np.full((B,), bs * W, np.int32)
+        ctx[0] = bs * (W - 1)  # whole blocks only, trash slot masked
+        q_len = np.full((B,), T, np.int32)
+    ctx = np.maximum(ctx, q_len)
+    return dict(q=q, kq=kq, vq=vq, ks=ks, vs=vs, k_ref=k_ref, v_ref=v_ref,
+                tables=tables, q_start=q_start, q_len=q_len, ctx=ctx, bs=bs)
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+@pytest.mark.parametrize("partial", [True, False])
+def test_ragged_kernel_quantized_bitwise(kv_dtype, partial):
+    """Quantized in-kernel dequant == dequantize-then-run, bitwise, with a
+    NaN trash block in play; dead rows and tile pad slots are exact 0."""
+    c = _quantized_kernel_case(7, B=3, T=4, W=4, bs=16,
+                               kv_dtype=kv_dtype, partial=partial)
+    kw = dict(block_size=c["bs"], max_q_len=4, interpret=True)
+    out_q = paged_attention_ragged(
+        jnp.asarray(c["q"]), jnp.asarray(c["kq"]), jnp.asarray(c["vq"]),
+        jnp.asarray(c["tables"]), jnp.asarray(c["q_start"]),
+        jnp.asarray(c["q_len"]), jnp.asarray(c["ctx"]),
+        k_scale=jnp.asarray(c["ks"]), v_scale=jnp.asarray(c["vs"]), **kw,
+    )
+    out_ref = paged_attention_ragged(
+        jnp.asarray(c["q"]), jnp.asarray(c["k_ref"]),
+        jnp.asarray(c["v_ref"]),
+        jnp.asarray(c["tables"]), jnp.asarray(c["q_start"]),
+        jnp.asarray(c["q_len"]), jnp.asarray(c["ctx"]), **kw,
+    )
+    out_q, out_ref = np.asarray(out_q), np.asarray(out_ref)
+    assert np.isfinite(out_q).all(), "trash-block NaN leaked"
+    np.testing.assert_array_equal(out_q, out_ref)
+    if partial:
+        # dead row (q_len == 0) must come back as exact zeros
+        T = 4
+        dead = out_q[T:2 * T]
+        assert (dead == 0.0).all()
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_decode_kernel_quantized_bitwise(kv_dtype):
+    c = _quantized_kernel_case(11, B=4, T=1, W=3, bs=16,
+                               kv_dtype=kv_dtype, partial=False)
+    q = c["q"].reshape(4, 4, 32)
+    lens = np.array([32, 17, 0, 33], np.int32)  # row 0's trash slot masked
+    out_q = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(c["kq"]), jnp.asarray(c["vq"]),
+        jnp.asarray(c["tables"]), jnp.asarray(lens),
+        block_size=c["bs"], interpret=True,
+        k_scale=jnp.asarray(c["ks"]), v_scale=jnp.asarray(c["vs"]),
+    )
+    out_ref = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(c["k_ref"]), jnp.asarray(c["v_ref"]),
+        jnp.asarray(c["tables"]), jnp.asarray(lens),
+        block_size=c["bs"], interpret=True,
+    )
+    out_q, out_ref = np.asarray(out_q), np.asarray(out_ref)
+    assert np.isfinite(out_q).all()
+    np.testing.assert_array_equal(out_q, out_ref)
+    assert (out_q[2] == 0.0).all()  # seq_len 0 row
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_kernel_vs_einsum_reference(kv_dtype):
+    """Sanity anchor: the quantized kernel agrees with a plain gathered
+    softmax-attention einsum over the dequantized cache (float tolerance,
+    not bitwise — different op order)."""
+    c = _quantized_kernel_case(13, B=2, T=4, W=2, bs=16,
+                               kv_dtype=kv_dtype, partial=False)
+    B, T, W, bs, H, KV, hd = 2, 4, 2, 16, 4, 2, 32
+    out_q = np.asarray(paged_attention_ragged(
+        jnp.asarray(c["q"]), jnp.asarray(c["kq"]), jnp.asarray(c["vq"]),
+        jnp.asarray(c["tables"]), jnp.asarray(c["q_start"]),
+        jnp.asarray(c["q_len"]), jnp.asarray(c["ctx"]),
+        block_size=bs, max_q_len=T, interpret=True,
+        k_scale=jnp.asarray(c["ks"]), v_scale=jnp.asarray(c["vs"]),
+    ))
+    # naive: gather rows, causal softmax per (row, head)
+    k_lin = c["k_ref"][c["tables"].reshape(-1)].reshape(
+        B, W, KV, bs, hd).transpose(0, 2, 1, 3, 4).reshape(B, KV, W * bs, hd)
+    v_lin = c["v_ref"][c["tables"].reshape(-1)].reshape(
+        B, W, KV, bs, hd).transpose(0, 2, 1, 3, 4).reshape(B, KV, W * bs, hd)
+    scale = 1.0 / np.sqrt(hd)
+    for r in range(B):
+        for t in range(T):
+            pos = c["ctx"][r] - c["q_len"][r] + t
+            qv = c["q"][r * T + t]                     # [H, hd]
+            for h in range(H):
+                g = h * KV // H
+                logits = (qv[h] @ k_lin[r, g, :pos + 1].T) * scale
+                p = np.exp(logits - logits.max())
+                p /= p.sum()
+                want = p @ v_lin[r, g, :pos + 1]
+                got = out_q[r * T + t, h]
+                np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+# ------------------ model-level quality budget (CPU) ----------------------
+
+
+def _logprobs(weight_dtype, kv_dtype):
+    """Valid-position logprobs of a mixed ragged prefill batch (three rows
+    of different lengths) through the full einsum model path."""
+    eng = make_cfg(weight_dtype, kv_dtype)
+    params = model_lib.init_params(jax.random.PRNGKey(0), MC)
+    params = quant.quantize_params(params, weight_dtype)
+    cache = model_lib.init_cache(MC, eng)
+    rng = np.random.default_rng(3)
+    B, T, W = 3, 32, 4
+    tokens = rng.integers(1, MC.vocab_size, size=(B, T)).astype(np.int32)
+    lens = np.array([32, 17, 5], np.int32)
+    positions = np.broadcast_to(np.arange(T), (B, T)).copy().astype(np.int32)
+    for r, ln in enumerate(lens):
+        positions[r, ln:] = -1
+        tokens[r, ln:] = 0
+    tables = 1 + np.arange(B * W).reshape(B, W).astype(np.int32)
+    _, h = model_lib.forward(
+        MC, eng, params, cache, jnp.asarray(tokens),
+        jnp.asarray(positions), jnp.asarray(tables),
+    )
+    logits = model_lib.logits_fn(MC, params, h)
+    lp = np.asarray(jax.nn.log_softmax(
+        logits.astype(jnp.float32), axis=-1))
+    return [lp[r, :ln] for r, ln in enumerate(lens)]
+
+
+@pytest.mark.parametrize("weight_dtype,kv_dtype", [
+    ("int8", "int8"), ("fp8", "fp8"), ("bf16", "int8"), ("int8", "bf16"),
+])
+def test_logprob_divergence_budget(weight_dtype, kv_dtype):
+    ref = _logprobs("bf16", "bf16")
+    got = _logprobs(weight_dtype, kv_dtype)
+    budget = max(LOGPROB_BUDGET.get(weight_dtype, 0.0),
+                 LOGPROB_BUDGET.get(kv_dtype, 0.0))
+    worst = max(
+        float(np.max(np.abs(g - r))) for g, r in zip(got, ref)
+    )
+    assert np.isfinite(worst)
+    assert worst <= budget, (
+        f"{weight_dtype}/{kv_dtype} logprob divergence {worst:.4f} "
+        f"exceeds budget {budget}"
+    )
+    assert worst > 0.0  # quant-on must actually be exercising the path
+
+
+# ------------------- engine-level byte-parity suites ----------------------
+
+
+def mk_req(i, prompt, max_tokens=20):
+    return Request(request_id=f"q{i}", token_ids=list(prompt),
+                   max_tokens=max_tokens, temperature=0.0, ignore_eos=True)
+
+
+async def _run_streams(cfg, prompts, max_tokens=20):
+    eng = InferenceEngine(MC, cfg, seed=0)
+    await eng.start()
+
+    async def one(i, p):
+        return [o.token_id async for o in eng.submit(mk_req(i, p,
+                                                           max_tokens))]
+
+    streams = await asyncio.gather(
+        *[one(i, p) for i, p in enumerate(prompts)])
+    await eng.stop()
+    return streams
+
+
+def _prompts(n=3, lo=8, hi=40, seed=5):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(1, MC.vocab_size,
+                              size=int(rng.integers(lo, hi))))
+            for _ in range(n)]
+
+
+@pytest.mark.anyio
+@pytest.mark.slow
+async def test_engine_bf16_config_byte_parity():
+    """Explicit bf16/bf16 knobs stream byte-identically to the default
+    config — the quant plumbing is invisible when off."""
+    prompts = _prompts()
+    base = await _run_streams(make_cfg(), prompts)
+    explicit = await _run_streams(
+        make_cfg(weight_dtype="bf16", kv_dtype="bf16"), prompts)
+    assert base == explicit
+
+
+@pytest.mark.anyio
+@pytest.mark.slow
+@pytest.mark.parametrize("weight_dtype,kv_dtype",
+                         [("int8", "int8"), ("fp8", "fp8")])
+async def test_engine_quant_serves_tokens(weight_dtype, kv_dtype):
+    """Quant-on engine completes greedy requests deterministically (two
+    identical runs agree byte-for-byte)."""
+    prompts = _prompts(seed=6)
+    cfg = make_cfg(weight_dtype, kv_dtype)
+    a = await _run_streams(cfg, prompts)
+    b = await _run_streams(cfg, prompts)
+    assert a == b
+    assert all(len(s) == 20 for s in a)
+
+
+@pytest.mark.anyio
+@pytest.mark.slow
+async def test_spec_decode_byte_parity_quant_on():
+    """The spec-on == spec-off greedy stream invariant survives a
+    quantized KV cache: per-token scales make verify-window rewrites
+    reproduce the exact bytes the sequential path wrote."""
+    prompts = [[3, 5, 3, 5, 3, 5, 3, 5, 7, 3, 5], [9] * 12, [2, 4, 6] * 5]
+    off = await _run_streams(
+        make_cfg("int8", "int8", spec_mode="off"), prompts)
+    on = await _run_streams(
+        make_cfg("int8", "int8", spec_mode="ngram", spec_k=4), prompts)
+    assert off == on
+
+
+@pytest.mark.anyio
+@pytest.mark.slow
+async def test_chunked_prefill_byte_parity_quant_on():
+    """Chunked == whole-bucket prefill with a quantized cache: chunk
+    boundaries don't change any token's quantized bytes."""
+    prompts = _prompts(n=2, lo=90, hi=120, seed=8)
+    whole = await _run_streams(
+        make_cfg("int8", "int8", prefill_chunk_tokens=0), prompts)
+    chunked = await _run_streams(
+        make_cfg("int8", "int8", prefill_chunk_tokens=64), prompts)
+    assert whole == chunked
+
+
+# --------------------- kvbm + disagg round-trips --------------------------
+
+
+def _quant_block(seed, kv_dtype, L=2, KV=2, bs=8, hd=16):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((L, KV, bs, hd)).astype(np.float32)
+    v = rng.standard_normal((L, KV, bs, hd)).astype(np.float32)
+    kq, ks = quant.kv_quantize_cache_np(k, kv_dtype)
+    vq, vs = quant.kv_quantize_cache_np(v, kv_dtype)
+    return {"k": kq, "v": vq, "ks": ks, "vs": vs}
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_host_pool_disk_roundtrip_quantized(kv_dtype, tmp_path):
+    """G2→G3 spill → onboard returns the quantized payload bit-exactly:
+    storage dtype, pages, and float32 scales all survive the npz hop."""
+    pool = HostBlockPool(1, str(tmp_path), 4)
+    a = _quant_block(1, kv_dtype)
+    b = _quant_block(2, kv_dtype)
+    pool.put(10, a)
+    pool.put(11, b)  # capacity 1: block 10 spills to disk
+    assert pool.stats.spills == 1
+    got = pool.get(10)
+    assert got is not None
+    assert set(got) == {"k", "v", "ks", "vs"}
+    for key in ("k", "v", "ks", "vs"):
+        assert got[key].dtype == a[key].dtype
+        np.testing.assert_array_equal(
+            got[key].view(np.uint8), a[key].view(np.uint8))
+
+
+def test_host_pool_legacy_layout_still_readable(tmp_path):
+    """Pre-quant spill files ({"k","v","dtype"} npz) keep loading."""
+    pool = HostBlockPool(1, str(tmp_path), 4)
+    k = np.arange(64, dtype=np.float32).reshape(2, 2, 4, 4)
+    kb = k.astype(ml_dtypes.bfloat16)
+    path = tmp_path / "00000000000000aa.npz"
+    np.savez(path, k=kb.view(np.uint16), v=kb.view(np.uint16),
+             dtype=np.asarray("bfloat16"))
+    pool._disk[0xAA] = path
+    got = pool.get(0xAA)
+    assert got is not None and got["k"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got["k"].view(np.uint16),
+                                  kb.view(np.uint16))
+
+
+def test_host_pool_byte_cap_doubles_int8_residency():
+    """Satellite pin: with the G2 pool bounded by BYTES, int8 payloads
+    (half the page bytes of bf16) stay resident at ~2x the block count,
+    and the incremental byte accounting matches an exact recount."""
+    L, KV, bs, hd = 2, 2, 8, 64
+
+    def bf16_block(i):
+        a = np.full((L, KV, bs, hd), i, ml_dtypes.bfloat16)
+        return {"k": a, "v": a.copy()}
+
+    def int8_block(i):
+        return _quant_block(i, "int8", L=L, KV=KV, bs=bs, hd=hd)
+
+    bf16_bytes = sum(a.nbytes for a in bf16_block(0).values())
+    cap = bf16_bytes * 4  # room for exactly 4 bf16 blocks
+    pool16 = HostBlockPool(10_000, capacity_bytes=cap)
+    pool8 = HostBlockPool(10_000, capacity_bytes=cap)
+    for i in range(16):
+        pool16.put(i, bf16_block(i))
+        pool8.put(i, int8_block(i))
+    assert pool16.stats.g2_blocks == 4
+    assert pool8.stats.g2_blocks >= 7  # ~2x (f32 scales cost 4/hd extra)
+    for pool in (pool16, pool8):
+        recount = sum(a.nbytes for d in pool._mem.values()
+                      for a in d.values())
+        assert pool.stats.g2_bytes == recount
+        assert recount <= cap
+    # evictions under the byte cap are LRU-ordered drops (no disk tier)
+    assert pool16.stats.drops == 12
+    assert 0 not in pool16._mem and 15 in pool16._mem
+
+
+def test_host_pool_unbounded_bytes_by_default():
+    pool = HostBlockPool(8)
+    for i in range(8):
+        pool.put(i, _quant_block(i, "int8"))
+    assert pool.stats.g2_blocks == 8 and pool.stats.drops == 0
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_wire_roundtrip_quantized(kv_dtype):
+    from dynamo_tpu.disagg.protocol import (
+        KvIntegrityError, kv_from_wire, kv_to_wire,
+    )
+
+    data = _quant_block(4, kv_dtype)
+    wire = kv_to_wire(data)
+    assert wire["scale_dtype"] == "float32"
+    back = kv_from_wire(wire)
+    assert set(back) == {"k", "v", "ks", "vs"}
+    for key in data:
+        assert back[key].dtype == data[key].dtype
+        np.testing.assert_array_equal(
+            back[key].view(np.uint8), data[key].view(np.uint8))
+    # a corrupted scale payload is rejected, never scattered
+    bad = dict(wire)
+    raw = bytearray(bad["ks"])
+    raw[0] ^= 0xFF
+    bad["ks"] = bytes(raw)
+    with pytest.raises(KvIntegrityError):
+        kv_from_wire(bad)
+
+
+def test_wire_plain_frames_interoperable():
+    """Frames without scales (older bf16 peers) still decode to a plain
+    {"k","v"} pair — and a plain payload encodes without scale keys."""
+    from dynamo_tpu.disagg.protocol import kv_from_wire, kv_to_wire
+
+    a = np.arange(32, dtype=np.float32).reshape(2, 2, 2, 4)
+    wire = kv_to_wire({"k": a, "v": a + 1})
+    assert "ks" not in wire and "scale_shape" not in wire
+    back = kv_from_wire(wire)
+    assert set(back) == {"k", "v"}
+    np.testing.assert_array_equal(back["v"], a + 1)
+
+
+# ------------------- aggregator forward-compat gauges ---------------------
+
+
+@pytest.mark.anyio
+async def test_aggregator_kvbm_quant_gauges_zero_default():
+    """The new kvbm snapshot counters land as per-worker gauges and
+    zero-default for workers that never publish them (pre-quant builds)."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    import msgpack
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        agg = MetricsAggregator(runtime, "backend")
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        # worker 1: an old build — kvbm dict without the new counters
+        await runtime.store.publish(subject + "1", msgpack.packb({
+            "worker_id": 1, "kv_usage": 0.1,
+            "kvbm": {"host_pool_bytes": 128, "spills_total": 0},
+        }))
+        # worker 2: full quant-era snapshot
+        await runtime.store.publish(subject + "2", msgpack.packb({
+            "worker_id": 2, "kv_usage": 0.2,
+            "kvbm": {"host_pool_bytes": 512, "spills_total": 1,
+                     "onboard_requests_total": 4, "g4_puts_total": 9,
+                     "g4_hits_total": 3, "peer_hits_total": 2},
+        }))
+        for _ in range(100):
+            if {"1", "2"} <= set(agg.worker_stats):
+                break
+            await asyncio.sleep(0.01)
+        body = runtime.metrics.render().decode()
+        c = 'component="backend"'
+        assert f'kvbm_onboard_requests_total{{{c},worker="2"}} 4' in body
+        assert f'kvbm_g4_puts_total{{{c},worker="2"}} 9' in body
+        assert f'kvbm_g4_hits_total{{{c},worker="2"}} 3' in body
+        assert f'kvbm_peer_hits_total{{{c},worker="2"}} 2' in body
+        assert f'kvbm_onboard_requests_total{{{c},worker="1"}} 0' in body
+        assert f'kvbm_g4_hits_total{{{c},worker="1"}} 0' in body
+        # stale expiry clears the new label sets too
+        import time
+
+        agg._clock = lambda: time.monotonic() + 10_000.0
+        agg.expire_stale()
+        body = runtime.metrics.render().decode()
+        assert "kvbm_g4_puts_total{" not in body
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
+
+
+def test_kvbm_snapshot_exports_counters():
+    """KvbmManager.snapshot carries the four new counters (what the
+    worker publisher actually sends)."""
+    from dynamo_tpu.kvbm.manager import KvbmManager, KvbmStats
+
+    mgr = object.__new__(KvbmManager)
+    mgr.host_pool = HostBlockPool(4)
+    mgr.stats = KvbmStats(offloaded_blocks=7, onboarded_blocks=5,
+                          onboard_requests=2, g4_puts=3, g4_hits=1,
+                          peer_hits=4)
+    snap = KvbmManager.snapshot(mgr)
+    assert snap["onboard_requests_total"] == 2
+    assert snap["g4_puts_total"] == 3
+    assert snap["g4_hits_total"] == 1
+    assert snap["peer_hits_total"] == 4
