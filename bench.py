@@ -746,6 +746,62 @@ def _planner_sim_fields(base_env: dict, timeout_s: float = 180.0) -> dict:
         return {"planner_sim_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+async def run_replay_gate() -> dict:
+    """Trace-replay scoreboard columns: one seeded bursty multi-tenant
+    replay (CPU-only) against a real-engine SimCluster — per-tier latency,
+    SLO-violation rate, prefix-hit rate, and whether the recorder/span
+    cross-checks agreed with the client-side measurements."""
+    import logging
+
+    logging.getLogger("dynamo_tpu").setLevel(logging.WARNING)
+    from dynamo_tpu.replay.__main__ import scenario_config
+    from dynamo_tpu.replay.driver import ReplaySettings, run_cluster_replay
+    from dynamo_tpu.replay.scoreboard import build_scoreboard
+    from dynamo_tpu.replay.trace import generate_trace
+
+    seed = int(os.environ.get("BENCH_REPLAY_SEED", 0))
+    trace = generate_trace(scenario_config("bursty", seed))
+    with tempfile.TemporaryDirectory() as workdir:
+        run = await run_cluster_replay(
+            trace, ReplaySettings(time_scale=2.0), workdir=workdir)
+    rep = build_scoreboard(trace, run)
+    fields = {
+        "replay_ok": rep["ok"],
+        "replay_seed": seed,
+        "replay_digest": rep["outcome_digest"],
+        "replay_requests": rep["requests"],
+        "replay_aborted": rep["aborted"],
+        "replay_errors": rep["errors"],
+        "replay_slo_violation_rate": rep["slo_violation_rate"],
+        "replay_prefix_hit_rate": rep["prefix_hit_rate"],
+        "replay_chip_s_per_1m_tok": rep["chip_seconds_per_1m_output_tokens"],
+    }
+    for tier, row in sorted(rep["tiers"].items()):
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms", "itl_p99_ms"):
+            fields[f"replay_tier{tier}_{key}"] = row[key]
+    return fields
+
+
+def _replay_fields(base_env: dict, timeout_s: float = 420.0) -> dict:
+    """Replay gate in a CPU-pinned subprocess, same contract as
+    ``_planner_sim_fields``: failures degrade to an error note, never a
+    broken bench. BENCH_REPLAY=0 skips it entirely."""
+    if os.environ.get("BENCH_REPLAY", "1").lower() in ("0", "false", "off"):
+        return {}
+    env = dict(base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--replay"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        line = next(ln for ln in reversed(out.stdout.splitlines())
+                    if ln.startswith("{"))
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — must never break the bench
+        return {"replay_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> None:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     bench_timeout = float(os.environ.get("BENCH_TIMEOUT", 2400))
@@ -792,6 +848,7 @@ def main() -> None:
     if errors:
         result["error"] = "; ".join(errors)
     result.update(_planner_sim_fields(base_env))
+    result.update(_replay_fields(base_env))
     print(json.dumps(result))
 
 
@@ -804,5 +861,9 @@ if __name__ == "__main__":
         import asyncio
 
         print(json.dumps(asyncio.run(run_planner_sim())))
+    elif "--replay" in sys.argv:
+        import asyncio
+
+        print(json.dumps(asyncio.run(run_replay_gate())))
     else:
         main()

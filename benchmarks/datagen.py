@@ -35,6 +35,11 @@ class GeneratedRequest:
     token_ids: List[int]
     group: int
     branch: int
+    # shared-segment lengths of this prompt's ``[group | branch | tail]``
+    # layout — carried so consumers can compute the ground-truth prefix
+    # dedup without re-deriving the generator's split arithmetic
+    group_len: int = 0
+    branch_len: int = 0
 
 
 def generate_prefix_dataset(
@@ -71,8 +76,34 @@ def generate_prefix_dataset(
             token_ids=(group_prefixes[g] + branch_prefixes[g][b]
                        + toks(tail_len)),
             group=g, branch=b,
+            group_len=group_len, branch_len=branch_len,
         ))
     return out
+
+
+def prefix_ground_truth(dataset: List[GeneratedRequest]) -> dict:
+    """Ground-truth shared-prefix accounting over an actual sampled dataset.
+
+    ``shared_tokens_total`` counts every shared-segment token as prompted;
+    ``shared_tokens_dedup`` counts each distinct (group) and (group, branch)
+    prefix once — what a perfect prefix cache stores. The difference,
+    ``prefix_hit_potential_tokens``, is the denominator a measured
+    prefix-hit rate should be judged against: tokens a perfect cache would
+    NOT recompute."""
+    total_prompt = sum(len(r.token_ids) for r in dataset)
+    shared_total = sum(r.group_len + r.branch_len for r in dataset)
+    groups = {}
+    branches = {}
+    for r in dataset:
+        groups[r.group] = r.group_len
+        branches[(r.group, r.branch)] = r.branch_len
+    dedup = sum(groups.values()) + sum(branches.values())
+    return {
+        "total_prompt_tokens": total_prompt,
+        "shared_tokens_total": shared_total,
+        "shared_tokens_dedup": dedup,
+        "prefix_hit_potential_tokens": max(0, shared_total - dedup),
+    }
 
 
 # ----------------------------- load schedules -----------------------------
@@ -139,20 +170,13 @@ class RequestRecord:
     output_tokens: int = 0
     itls: List[float] = field(default_factory=list)
     error: Optional[str] = None
+    tier: Optional[int] = None     # deadline tier; None = untiered run
 
 
-def summarize(records: List[RequestRecord], elapsed_s: float) -> dict:
-    ok = [r for r in records if r.error is None and r.end is not None]
+def _latency_block(ok: List[RequestRecord]) -> dict:
     ttfts = [r.ttft for r in ok if r.ttft is not None]
     itls = [x for r in ok for x in r.itls]
-    out_tokens = sum(r.output_tokens for r in ok)
     return {
-        "requests": len(records),
-        "completed": len(ok),
-        "errors": len(records) - len(ok),
-        "elapsed_s": round(elapsed_s, 2),
-        "request_throughput_rps": round(len(ok) / max(elapsed_s, 1e-9), 2),
-        "output_tok_s": round(out_tokens / max(elapsed_s, 1e-9), 1),
         "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 1),
         "ttft_p90_ms": round(percentile(ttfts, 90) * 1e3, 1),
         "ttft_p99_ms": round(percentile(ttfts, 99) * 1e3, 1),
@@ -161,3 +185,37 @@ def summarize(records: List[RequestRecord], elapsed_s: float) -> dict:
         "itl_p50_ms": round(percentile(itls, 50) * 1e3, 2),
         "itl_p99_ms": round(percentile(itls, 99) * 1e3, 2),
     }
+
+
+def summarize(
+    records: List[RequestRecord],
+    elapsed_s: float,
+    dataset: Optional[List[GeneratedRequest]] = None,
+) -> dict:
+    ok = [r for r in records if r.error is None and r.end is not None]
+    out_tokens = sum(r.output_tokens for r in ok)
+    out = {
+        "requests": len(records),
+        "completed": len(ok),
+        "errors": len(records) - len(ok),
+        "elapsed_s": round(elapsed_s, 2),
+        "request_throughput_rps": round(len(ok) / max(elapsed_s, 1e-9), 2),
+        "output_tok_s": round(out_tokens / max(elapsed_s, 1e-9), 1),
+    }
+    out.update(_latency_block(ok))
+    # per-tier breakdown when the records carry deadline tiers — the shared
+    # report shape for the load driver and the replay scoreboard
+    if any(r.tier is not None for r in records):
+        tiers: dict = {}
+        for t in sorted({r.tier for r in records if r.tier is not None}):
+            sub = [r for r in ok if r.tier == t]
+            tiers[str(t)] = {
+                "requests": sum(1 for r in records if r.tier == t),
+                "completed": len(sub),
+                **_latency_block(sub),
+            }
+        out["tiers"] = tiers
+    # ground-truth prefix-dedup accounting: the prefix-hit-rate denominator
+    if dataset is not None:
+        out.update(prefix_ground_truth(dataset))
+    return out

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import random
 import time
 from typing import List, Optional
 
@@ -28,6 +29,18 @@ from .datagen import (
     GeneratedRequest, LoadSchedule, PrefixDatasetConfig, RequestRecord,
     generate_prefix_dataset, summarize,
 )
+
+
+def assign_tiers(
+    n: int, weights: List[float], seed: int = 0,
+) -> List[Optional[int]]:
+    """Seeded deadline-tier assignment: tier ``i`` drawn with
+    ``weights[i]``. An empty weight list means an untiered run (all None)."""
+    if not weights:
+        return [None] * n
+    rng = random.Random(seed)
+    tiers = list(range(len(weights)))
+    return [rng.choices(tiers, weights=weights)[0] for _ in range(n)]
 
 
 async def run_one(
@@ -78,9 +91,12 @@ async def run_one(
 
 async def closed_loop(
     url: str, model: str, dataset: List[GeneratedRequest], osl: int,
-    concurrency: int,
+    concurrency: int, tiers: Optional[List[Optional[int]]] = None,
 ) -> dict:
-    records = [RequestRecord(start=0.0) for _ in dataset]
+    records = [
+        RequestRecord(start=0.0, tier=tiers[i] if tiers else None)
+        for i in range(len(dataset))
+    ]
     sem = asyncio.Semaphore(concurrency)
     t0 = time.monotonic()
     async with aiohttp.ClientSession() as session:
@@ -91,18 +107,21 @@ async def closed_loop(
                               records[i])
 
         await asyncio.gather(*(gated(i) for i in range(len(dataset))))
-    report = summarize(records, time.monotonic() - t0)
+    report = summarize(records, time.monotonic() - t0, dataset=dataset)
     report["mode"] = f"closed_loop(c={concurrency})"
     return report
 
 
 async def open_loop(
     url: str, model: str, dataset: List[GeneratedRequest], osl: int,
-    schedule: LoadSchedule,
+    schedule: LoadSchedule, tiers: Optional[List[Optional[int]]] = None,
 ) -> dict:
     times = schedule.arrival_times()
     n = min(len(times), len(dataset))
-    records = [RequestRecord(start=0.0) for _ in range(n)]
+    records = [
+        RequestRecord(start=0.0, tier=tiers[i] if tiers else None)
+        for i in range(n)
+    ]
     t0 = time.monotonic()
     async with aiohttp.ClientSession() as session:
 
@@ -113,7 +132,7 @@ async def open_loop(
             await run_one(session, url, model, dataset[i], osl, records[i])
 
         await asyncio.gather(*(timed(i) for i in range(n)))
-    report = summarize(records, time.monotonic() - t0)
+    report = summarize(records, time.monotonic() - t0, dataset=dataset[:n])
     report["mode"] = (f"open_loop({schedule.kind}, rate={schedule.rate}, "
                       f"duration={schedule.duration_s}s)")
     return report
@@ -137,6 +156,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--duration", type=float, default=30.0)
     p.add_argument("--period", type=float, default=20.0)
     p.add_argument("--amplitude", type=float, default=0.8)
+    p.add_argument(
+        "--tier-weights", default=None,
+        help="comma-separated deadline-tier weights (e.g. '0.6,0.4'); "
+             "requests get a seeded tier draw and the summary gains a "
+             "per-tier TTFT/ITL percentile breakdown",
+    )
     return p.parse_args(argv)
 
 
@@ -147,16 +172,21 @@ def main(argv=None) -> dict:
         prefix_ratio=args.prefix_ratio, groups=args.prefix_groups,
         seed=args.seed,
     ))
+    weights = ([float(w) for w in args.tier_weights.split(",")]
+               if args.tier_weights else [])
+    tiers = assign_tiers(len(dataset), weights, seed=args.seed)
     if args.schedule:
         report = asyncio.run(open_loop(
             args.url, args.model, dataset, args.osl,
             LoadSchedule(kind=args.schedule, rate=args.rate,
                          duration_s=args.duration, period_s=args.period,
                          amplitude=args.amplitude, seed=args.seed),
+            tiers=tiers,
         ))
     else:
         report = asyncio.run(closed_loop(
             args.url, args.model, dataset, args.osl, args.concurrency,
+            tiers=tiers,
         ))
     report["isl"] = args.isl
     report["osl"] = args.osl

@@ -83,6 +83,17 @@ class MetricsAggregator:
             "per-worker fraction of dispatched FLOPs burnt on padding",
             ["worker"]
         )
+        # recorder lifetime totals (reset at warmup): the replay scoreboard
+        # reconciles client-counted tokens against these
+        self._g_goodput_total = m.gauge(
+            "worker_goodput_tokens_total",
+            "per-worker lifetime goodput tokens since warmup", ["worker"]
+        )
+        self._g_steps_total = m.gauge(
+            "worker_steps_total",
+            "per-worker lifetime dispatched device windows since warmup",
+            ["worker"]
+        )
         # disagg handoff health ("disagg" key of the snapshot): fallbacks,
         # breaker state, transfer retries, orphan reaps
         self._g_dg_fallbacks = m.gauge(
@@ -233,6 +244,10 @@ class MetricsAggregator:
         self._g_goodput.labels(worker=wid).set(obs.get("goodput_tok_s", 0.0))
         self._g_pad_waste.labels(worker=wid).set(
             obs.get("padding_waste_ratio", 0.0))
+        self._g_goodput_total.labels(worker=wid).set(
+            obs.get("total_goodput_tokens", 0.0))
+        self._g_steps_total.labels(worker=wid).set(
+            obs.get("total_steps", 0.0))
         # forward-compat: non-disagg workers publish no "disagg" — zero
         dg = snap.get("disagg") or {}
         self._g_dg_fallbacks.labels(worker=wid).set(
@@ -278,6 +293,7 @@ class MetricsAggregator:
             self._last_seen.pop(wid, None)
             for gauge in (self._g_usage, self._g_running, self._g_waiting,
                           self._g_spec_accept, self._g_mfu, self._g_goodput,
+                          self._g_goodput_total, self._g_steps_total,
                           self._g_pad_waste, self._g_dg_fallbacks,
                           self._g_dg_breaker, self._g_dg_retries,
                           self._g_dg_orphans, self._g_kvbm_bytes,
@@ -362,6 +378,15 @@ class MetricsAggregator:
     def goodput_tok_s(self):
         """Aggregate goodput across live workers (sum, not mean)."""
         vals = [(s.get("obs") or {}).get("goodput_tok_s")
+                for s in self.worker_stats.values()]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) if vals else None
+
+    def goodput_tokens_total(self):
+        """Summed recorder lifetime goodput tokens across live workers
+        (None when no worker publishes a recorder) — the live-deployment
+        side of the replay token cross-check."""
+        vals = [(s.get("obs") or {}).get("total_goodput_tokens")
                 for s in self.worker_stats.values()]
         vals = [v for v in vals if v is not None]
         return sum(vals) if vals else None

@@ -40,7 +40,7 @@ import math
 import random
 import socket
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..frontend.service import AdmissionController, AdmissionError, percentile
 from ..llm.migration import Migration
@@ -194,7 +194,7 @@ class SimWorkerEngine(AsyncEngine):
 class SimWorker:
     wid: int
     runtime: DistributedRuntime
-    engine: SimWorkerEngine
+    engine: AsyncEngine
     served: object
     component: str
 
@@ -213,6 +213,7 @@ class SimCluster:
         decode_component: str = "backend",
         timing: Optional[SimTiming] = None,
         drain_deadline_s: float = 0.15,
+        engine_factory: Optional[Callable[[], AsyncEngine]] = None,
     ):
         self.cfg = cfg
         self.namespace = namespace
@@ -220,6 +221,10 @@ class SimCluster:
         self.decode_component = decode_component
         self.timing = timing or SimTiming()
         self.drain_deadline_s = drain_deadline_s
+        # when set, spawned workers serve engines from this factory (e.g.
+        # real tiny InferenceEngines for the trace-replay scoreboard)
+        # instead of the simulated load model
+        self.engine_factory = engine_factory
         self.prefill_pool = ResizablePool(1)
         # degradation feedback: cheapened decode steps while clamps hold
         self.decode_scale = 1.0
@@ -236,7 +241,8 @@ class SimCluster:
 
     async def spawn(self, component: str) -> int:
         rt = await DistributedRuntime.from_settings(self.cfg)
-        engine = SimWorkerEngine(self)
+        engine = (self.engine_factory() if self.engine_factory is not None
+                  else SimWorkerEngine(self))
         ep = (rt.namespace(self.namespace).component(component)
               .endpoint("generate"))
         served = await ep.serve_endpoint(engine, advertise_host="127.0.0.1")
@@ -250,6 +256,7 @@ class SimCluster:
         sw = self._workers.pop(worker_id)
         await sw.served.drain_and_stop(deadline_s=self.drain_deadline_s)
         await sw.runtime.shutdown()
+        await self._stop_engine(sw.engine)
         await self._resize_prefill()
 
     async def flip(self, worker_id: int, component: str) -> None:
@@ -282,6 +289,7 @@ class SimCluster:
             await sw.runtime.shutdown()
         except Exception:
             pass
+        await self._stop_engine(sw.engine)
         await self._resize_prefill()
 
     # ------------------------- lifecycle ----------------------------
@@ -303,7 +311,20 @@ class SimCluster:
                 await sw.runtime.shutdown()
             except Exception:
                 pass
+            await self._stop_engine(sw.engine)
         self._workers.clear()
+
+    @staticmethod
+    async def _stop_engine(engine: AsyncEngine) -> None:
+        """Real engines (factory-built) own a decode loop that must stop
+        with the worker; the simulated engine has no lifecycle."""
+        stop = getattr(engine, "stop", None)
+        if stop is None:
+            return
+        try:
+            await stop()
+        except Exception:
+            pass
 
     async def _resize_prefill(self) -> None:
         n = len(self.workers(self.prefill_component))

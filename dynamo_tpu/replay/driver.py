@@ -1,0 +1,526 @@
+"""Open-loop, timestamp-faithful trace replay.
+
+Two targets share one outcome shape:
+
+- :func:`run_cluster_replay` — in-process real-engine deployment: a
+  ``SimCluster`` whose workers serve tiny CPU-JAX ``InferenceEngine``s
+  behind real runtimes/ingress, routed through the KV-aware router and
+  Migration carryover. Because everything runs in one process, the driver
+  can also harvest the internal instrumentation the scoreboard
+  cross-checks against: flight-recorder lifetime totals, scheduler
+  prefix-cache counters, and the global span collector.
+- :func:`run_http_replay` — a live HTTP frontend, reusing the loadgen
+  streaming SSE measurement (client-side metrics only; the span/recorder
+  halves of the cross-check then come from the deployment's exporters).
+
+Replay is *open-loop*: request ``i`` fires at ``arrival_s / time_scale``
+regardless of how the cluster is doing — backpressure shows up as latency,
+exactly like production. The event track (maintenance preemption, worker
+kill, store flap) fires on the same clock.
+
+Client behaviour encoded in the trace is honoured here:
+
+- ``abort_after_tokens`` — the client disconnects after N tokens (the
+  abort-storm shape); the request scores as aborted, not failed.
+- ``reconnect_after_tokens`` — the client drops and re-issues once with
+  its received history as the prompt (budget shrunk accordingly).
+- ``finish_reason == "evacuated"`` — a maintenance evacuation finished the
+  stream under PR 14 semantics; the driver re-issues with carryover, the
+  client-visible contract the notice path promises.
+
+Worker kills mid-stream surface as broken streams and are retried by
+Migration itself (token carryover, original prompt-length reporting).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..llm.migration import Migration
+from ..router.kv_router import KvPushRouter, KvRouter
+from ..router.scheduler import KvRouterConfig
+from ..runtime.circuit import BreakerConfig, CircuitBreakerRegistry
+from ..runtime.component import DistributedRuntime
+from ..runtime.context import Context
+from ..runtime.store import StoreServer
+from ..utils.config import RuntimeConfig
+from ..utils.logging import get_logger
+from ..mocker.cluster import SimCluster, _free_port
+from ..tracing import (
+    InMemorySpanExporter, configure as tracing_configure, get_tracer,
+    reset as tracing_reset,
+)
+from .trace import ReplayTrace, TraceRequest
+
+log = get_logger("replay.driver")
+
+
+@dataclass
+class ReplaySettings:
+    """Cluster-target replay knobs. ``time_scale`` compresses the trace
+    clock: wall delay = trace offset / time_scale."""
+
+    time_scale: float = 1.0
+    n_workers: int = 2
+    engine_seed: int = 0
+    vocab_size: int = 256
+    num_blocks: int = 96
+    block_size: int = 4
+    max_model_len: int = 160
+    max_num_batched_tokens: int = 160
+    max_num_seqs: int = 4
+    migration_limit: int = 8
+    resume_limit: int = 4          # driver-level re-issues per request
+    drain_deadline_s: float = 0.2
+    request_timeout_s: float = 120.0
+    # max extra wall wait for an evacuable decode seat before a scheduled
+    # "preempt" event sends its notice (0 = fire exactly on schedule)
+    preempt_wait_s: float = 8.0
+
+
+@dataclass
+class RequestOutcome:
+    """Client-side record of one replayed request, plus the bookkeeping
+    the cross-checks need (trace id, per-submission token accounting)."""
+
+    request_id: str
+    tenant: str
+    pool: int
+    tier: int
+    isl: int
+    osl: int
+    arrival_s: float
+    trace_id: str = ""
+    ttft_s: Optional[float] = None
+    itls: List[float] = field(default_factory=list)
+    end_s: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    aborted: bool = False
+    resumes: int = 0        # evacuated-seat re-issues by the driver
+    reconnects: int = 0     # client-drop re-issues by the driver
+    # (prompt_len, tokens_received) per driver-visible submission — the
+    # client side of the recorder token reconciliation
+    submissions: List[Tuple[int, int]] = field(default_factory=list)
+
+    @property
+    def completed(self) -> bool:
+        return self.error is None and (self.aborted
+                                       or self.finish_reason is not None)
+
+
+@dataclass
+class ReplayRunResult:
+    outcomes: List[RequestOutcome]
+    elapsed_s: float
+    time_scale: float
+    events_fired: List[dict]
+    # engine-internal truth, summed over live + killed workers
+    recorder_goodput_tokens: float
+    recorder_steps: float
+    prefix_hits_blocks: int
+    prefix_queries_blocks: int
+    block_size: int
+    chips: int
+    device_kind: str
+    platform: str
+    spans: List[dict]
+    preempt: Dict[str, int]
+    num_kills: int
+    seed: int
+
+
+async def _drive_one(
+    req: TraceRequest, mig: Migration, outcome: RequestOutcome,
+    settings: ReplaySettings, loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Issue one trace request, honouring abort/reconnect behaviour and
+    re-issuing with carryover when a maintenance evacuation finishes the
+    stream. Tokens are deduped per attempt by frame index (finish frames
+    re-carry the last token) and spliced across driver re-issues."""
+    prompt = list(req.token_ids)
+    budget = req.osl
+    got: List[int] = []
+    ctx = Context(request_id=req.request_id)
+    outcome.trace_id = ctx.trace.trace_id
+    abort_at = req.abort_after_tokens
+    reconnect_at = req.reconnect_after_tokens
+    t0 = loop.time()
+    prev: Optional[float] = None
+    try:
+        for submission in range(settings.resume_limit + 1):
+            if submission == 0:
+                sub_ctx = ctx
+            else:
+                # re-issues need a DISTINCT request id: the engine keys
+                # seats by it, and a carryover landing on the same worker
+                # while the dropped seat is still cancelling would collide
+                # (ctx.child() keeps the parent id). Same trace, though —
+                # the span timeline must stay assembled per request.
+                sub_ctx = ctx.link_child(Context(
+                    request_id=f"{req.request_id}+r{submission}",
+                    trace=ctx.trace.child()))
+            stream = mig.generate(
+                {"token_ids": prompt, "max_tokens": budget,
+                 "ignore_eos": True},
+                sub_ctx,
+            )
+            toks: Dict[int, int] = {}
+            reason: Optional[str] = None
+            dropped = False
+            try:
+                async for frame in stream:
+                    now = loop.time()
+                    for t in frame.get("token_ids", []):
+                        if t >= 0:
+                            toks[frame["index"]] = t
+                    n_total = len(got) + len(toks)
+                    if n_total > 0:
+                        if outcome.ttft_s is None:
+                            outcome.ttft_s = now - t0
+                        elif prev is not None and now > prev:
+                            outcome.itls.append(now - prev)
+                        prev = now
+                    if frame.get("finished"):
+                        reason = frame.get("finish_reason")
+                        break
+                    if abort_at is not None and n_total >= abort_at:
+                        outcome.aborted = True
+                        break
+                    if reconnect_at is not None and n_total >= reconnect_at:
+                        reconnect_at = None
+                        outcome.reconnects += 1
+                        dropped = True
+                        break
+            finally:
+                await stream.aclose()
+            attempt_tokens = [toks[i] for i in sorted(toks)]
+            outcome.submissions.append((len(prompt), len(attempt_tokens)))
+            got.extend(attempt_tokens)
+            if outcome.aborted:
+                outcome.finish_reason = "aborted"
+                break
+            if reason == "evacuated" or dropped:
+                if reason == "evacuated":
+                    outcome.resumes += 1
+                budget = req.osl - len(got)
+                if budget <= 0:
+                    outcome.finish_reason = "length"
+                    break
+                prompt = list(req.token_ids) + got
+                continue
+            outcome.finish_reason = reason
+            break
+        else:
+            outcome.error = "resume limit exhausted"
+    except Exception as exc:  # noqa: BLE001 — per-request isolation
+        outcome.error = f"{type(exc).__name__}: {exc}"
+    outcome.tokens = got
+    outcome.end_s = loop.time() - t0
+
+
+async def run_cluster_replay(
+    trace: ReplayTrace, settings: Optional[ReplaySettings] = None,
+    workdir: str = ".",
+) -> ReplayRunResult:
+    """Replay ``trace`` against an in-process real-engine SimCluster and
+    return outcomes plus the engine-internal truth the scoreboard
+    cross-checks against."""
+    from ..engine.config import EngineConfig, ModelConfig
+    from ..engine.engine import InferenceEngine
+    from ..runtime.preemption import PreemptionCoordinator
+
+    settings = settings or ReplaySettings()
+    scale = max(settings.time_scale, 1e-6)
+
+    # full-fidelity spans into a fresh in-memory sink: the TTFT cross-check
+    # needs every worker.queue / engine.prefill span of this run, no
+    # sampling, no spans from earlier tests
+    tracing_reset()
+    tracing_configure(sample_ratio=1.0)
+    mem = InMemorySpanExporter()
+    get_tracer().add_exporter(mem)
+
+    model_cfg = ModelConfig.tiny(vocab_size=settings.vocab_size)
+    eng_cfg = EngineConfig(
+        num_blocks=settings.num_blocks, block_size=settings.block_size,
+        max_model_len=settings.max_model_len,
+        max_num_batched_tokens=settings.max_num_batched_tokens,
+        prefill_buckets=(settings.max_num_batched_tokens,),
+        decode_buckets=(4, 8), max_num_seqs=settings.max_num_seqs,
+    )
+
+    def _engine() -> InferenceEngine:
+        # identical seeds: greedy continuations after migration or
+        # evacuation resume are byte-identical wherever they land
+        return InferenceEngine(model_cfg, eng_cfg, seed=settings.engine_seed)
+
+    port = _free_port()
+    snap = f"{workdir}/replay-store.snap"
+    stores = {"live": StoreServer("127.0.0.1", port, persist_path=snap)}
+    await stores["live"].start()
+    cfg = RuntimeConfig(
+        store_addr=f"127.0.0.1:{port}",
+        namespace="replay",
+        store_reconnect_base_s=0.05,
+        store_reconnect_cap_s=0.2,
+        store_recover_timeout_s=15.0,
+        store_reconcile_grace_s=0.5,
+        # every runtime spawn re-configures the process-global tracer from
+        # its config — keep full-fidelity sampling through worker startup
+        trace_sample_ratio=1.0,
+    )
+    cluster = SimCluster(
+        cfg, namespace="replay", engine_factory=_engine,
+        drain_deadline_s=settings.drain_deadline_s,
+    )
+    await cluster.start(0, settings.n_workers)
+
+    front = await DistributedRuntime.from_settings(cfg)
+    client = await (front.namespace("replay")
+                    .component(cluster.decode_component)
+                    .endpoint("generate").client())
+    await client.wait_for_instances(settings.n_workers, timeout_s=30.0)
+    breakers = CircuitBreakerRegistry(
+        BreakerConfig(failure_threshold=3, open_timeout_s=1.0))
+    router = KvRouter(
+        client, client.endpoint.component,
+        block_size=settings.block_size, use_events=False, seed=0,
+        config=KvRouterConfig(replica_sync=False, snapshot_threshold=0),
+        breakers=breakers,
+    )
+    mig = Migration(KvPushRouter(router),
+                    migration_limit=settings.migration_limit,
+                    backoff_base_s=0.01,
+                    rng=random.Random(trace.seed))
+
+    def _engine_of(wid: int) -> InferenceEngine:
+        return cluster._workers[wid].engine
+
+    # warm every engine once (first compile + recorder warmup), then zero
+    # the lifetime totals so they count replay work only, and baseline the
+    # prefix-cache counters (warmup adds queries)
+    for wid in cluster.workers(cluster.decode_component):
+        eng = _engine_of(wid)
+        async for _ in eng.generate(
+            {"token_ids": [2, 3, 4, 5], "max_tokens": 2,
+             "ignore_eos": True},
+            Context(request_id=f"warmup-{wid}"),
+        ):
+            pass
+        eng.mark_obs_warmup_done()
+    prefix_base: Dict[int, Tuple[int, int]] = {}
+    for wid in cluster.workers(cluster.decode_component):
+        st = _engine_of(wid).scheduler.stats
+        prefix_base[wid] = (st.prefix_cache_hits, st.prefix_cache_queries)
+    mem.clear()
+
+    # retired-worker accumulators: totals harvested just before a kill
+    retired = {"goodput": 0.0, "steps": 0.0, "hits": 0, "queries": 0}
+    preempt_counts = {"notices": 0, "evacuated_peer": 0, "spilled": 0,
+                      "fallbacks": 0, "seats": 0}
+    events_fired: List[dict] = []
+
+    def _harvest(wid: int) -> None:
+        eng = _engine_of(wid)
+        obs = eng.obs_snapshot() or {}
+        retired["goodput"] += float(obs.get("total_goodput_tokens", 0.0))
+        retired["steps"] += float(obs.get("total_steps", 0.0))
+        st = eng.scheduler.stats
+        base = prefix_base.pop(wid, (0, 0))
+        retired["hits"] += st.prefix_cache_hits - base[0]
+        retired["queries"] += st.prefix_cache_queries - base[1]
+
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+
+    async def _events() -> None:
+        for ev in trace.events:
+            delay = t0 + ev.at_s / scale - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            wids = cluster.workers(cluster.decode_component)
+            fired = {"kind": ev.kind, "at_s": ev.at_s}
+            if ev.kind == "preempt" and wids:
+                if "worker_index" in ev.params:
+                    wid = wids[int(ev.params["worker_index"]) % len(wids)]
+                else:
+                    # maintenance hits the busiest worker. The scheduled
+                    # offset can land while everything is still queued or
+                    # mid-prefill (CPU replays run far slower than the
+                    # trace clock), so wait — bounded — for a decode seat
+                    # whose KV is actually worth evacuating.
+                    deadline = loop.time() + settings.preempt_wait_s
+                    while (loop.time() < deadline
+                           and not any(_engine_of(w).evacuable_seats()
+                                       for w in wids)):
+                        await asyncio.sleep(0.05)
+                    wid = max(wids, key=lambda w: (
+                        len(_engine_of(w).evacuable_seats()), -w))
+                coord = PreemptionCoordinator(
+                    _engine_of(wid), worker_key=f"replay-{wid}",
+                    notice_grace_s=0.0, evac_deadline_s=10.0,
+                )
+                report = await coord.notice(
+                    str(ev.params.get("reason", "maintenance")))
+                preempt_counts["notices"] += coord.num_notices
+                preempt_counts["evacuated_peer"] += coord.num_evacuated
+                preempt_counts["spilled"] += coord.num_spilled
+                preempt_counts["fallbacks"] += coord.num_fallbacks
+                preempt_counts["seats"] += len(report.results)
+                fired["worker"] = wid
+                fired["seats"] = len(report.results)
+                if ev.params.get("kill"):
+                    _harvest(wid)
+                    await cluster.kill(wid)
+                    fired["killed"] = True
+            elif ev.kind == "kill_worker" and wids:
+                wid = wids[int(ev.params.get("worker_index", -1))
+                           % len(wids)]
+                _harvest(wid)
+                await cluster.kill(wid)
+                fired["worker"] = wid
+            elif ev.kind == "store_flap":
+                down = float(ev.params.get("down_s", 0.2)) / scale
+                await stores["live"].stop()
+                await asyncio.sleep(down)
+                stores["live"] = StoreServer("127.0.0.1", port,
+                                             persist_path=snap)
+                await stores["live"].start()
+                fired["down_s"] = down
+            events_fired.append(fired)
+            log.info("replay event fired: %s", fired)
+
+    outcomes: List[RequestOutcome] = []
+    for r in trace.requests:
+        outcomes.append(RequestOutcome(
+            request_id=r.request_id, tenant=r.tenant, pool=r.pool,
+            tier=r.tier, isl=r.isl, osl=r.osl, arrival_s=r.arrival_s,
+        ))
+
+    async def _fire(i: int) -> None:
+        r = trace.requests[i]
+        delay = t0 + r.arrival_s / scale - loop.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        try:
+            await asyncio.wait_for(
+                _drive_one(r, mig, outcomes[i], settings, loop),
+                timeout=settings.request_timeout_s,
+            )
+        except asyncio.TimeoutError:
+            outcomes[i].error = "replay timeout"
+
+    events_task = asyncio.create_task(_events())
+    await asyncio.gather(*(_fire(i) for i in range(len(trace.requests))))
+    await events_task
+    elapsed = loop.time() - t0
+    # let worker-side stream teardown land its stage spans
+    await asyncio.sleep(0.2)
+
+    # engine-internal truth: live workers + harvested kills
+    goodput = retired["goodput"]
+    steps = retired["steps"]
+    hits, queries = retired["hits"], retired["queries"]
+    chips = 0
+    device_kind, platform = "cpu", "cpu"
+    for wid in cluster.workers(cluster.decode_component):
+        eng = _engine_of(wid)
+        obs = eng.obs_snapshot() or {}
+        goodput += float(obs.get("total_goodput_tokens", 0.0))
+        steps += float(obs.get("total_steps", 0.0))
+        st = eng.scheduler.stats
+        base = prefix_base.get(wid, (0, 0))
+        hits += st.prefix_cache_hits - base[0]
+        queries += st.prefix_cache_queries - base[1]
+        dev = eng.mesh.devices.flat[0]
+        chips += int(eng.mesh.devices.size)
+        device_kind = getattr(dev, "device_kind", "cpu")
+        platform = getattr(dev, "platform", "cpu")
+
+    spans = [s.to_dict()
+             for group in mem.by_trace().values() for s in group]
+    get_tracer().remove_exporter(mem)
+
+    await router.stop()
+    await client.stop()
+    await front.shutdown()
+    await cluster.shutdown()
+    await stores["live"].stop()
+
+    return ReplayRunResult(
+        outcomes=outcomes,
+        elapsed_s=elapsed,
+        time_scale=settings.time_scale,
+        events_fired=events_fired,
+        recorder_goodput_tokens=goodput,
+        recorder_steps=steps,
+        prefix_hits_blocks=hits,
+        prefix_queries_blocks=queries,
+        block_size=settings.block_size,
+        chips=chips,
+        device_kind=device_kind,
+        platform=platform,
+        spans=spans,
+        preempt=preempt_counts,
+        num_kills=cluster.num_kills,
+        seed=trace.seed,
+    )
+
+
+# ------------------------------ HTTP target ------------------------------
+
+
+async def run_http_replay(
+    trace: ReplayTrace, url: str, model: str = "mock",
+    time_scale: float = 1.0, timeout_s: float = 300.0,
+) -> List[RequestOutcome]:
+    """Replay against a live HTTP frontend with loadgen's streaming SSE
+    measurement. Client-side outcomes only: the span/recorder halves of
+    the cross-check come from the deployment's own exporters (span JSONL →
+    ``python -m dynamo_tpu.tracing --summary``, recorder totals → the
+    aggregator's ``worker_goodput_tokens_total``)."""
+    import aiohttp
+
+    from benchmarks.datagen import RequestRecord
+    from benchmarks.loadgen import run_one
+
+    scale = max(time_scale, 1e-6)
+    loop = asyncio.get_running_loop()
+    outcomes: List[RequestOutcome] = []
+    records: List[RequestRecord] = []
+    for r in trace.requests:
+        outcomes.append(RequestOutcome(
+            request_id=r.request_id, tenant=r.tenant, pool=r.pool,
+            tier=r.tier, isl=r.isl, osl=r.osl, arrival_s=r.arrival_s,
+        ))
+        records.append(RequestRecord(start=0.0, tier=r.tier))
+    t0 = loop.time()
+    async with aiohttp.ClientSession() as session:
+
+        async def _fire(i: int) -> None:
+            r = trace.requests[i]
+            delay = t0 + r.arrival_s / scale - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+
+            class _Gen:
+                token_ids = r.token_ids
+
+            await run_one(session, url, model, _Gen(), r.osl, records[i],
+                          timeout_s=timeout_s)
+
+        await asyncio.gather(*(_fire(i) for i in range(len(trace.requests))))
+    for out, rec in zip(outcomes, records):
+        out.ttft_s = rec.ttft
+        out.itls = rec.itls
+        out.end_s = (rec.end - rec.start) if rec.end else None
+        out.tokens = list(range(rec.output_tokens))  # count only over HTTP
+        out.error = rec.error
+        out.finish_reason = None if rec.error else "length"
+        out.submissions = [(out.isl, rec.output_tokens)]
+    return outcomes

@@ -1,0 +1,324 @@
+"""Replay scoreboard: per-tier SLO reporting with fail-on-disagreement
+cross-checks against the engine's own instrumentation.
+
+Headline metrics (all from client-side measurement):
+
+- per-tier TTFT/ITL p50/p99 + goodput (output tokens, tokens/s);
+- per-tier SLO-violation rate against the trace's :class:`TierSpec` SLOs
+  (scored over completed, non-aborted requests; aborts/preemptions are
+  accounted separately, not hidden inside the violation rate);
+- prefix-hit rate: scheduler prefix-cache hit tokens over the datagen
+  ground-truth hit-potential tokens (a *perfect* cache scores 1.0);
+- chip-seconds per 1M output tokens ($-proxy) plus the analytic roofline
+  from :mod:`..observability.flops` — ideal chip-seconds for the same
+  token volume at the device's peak — so the efficiency gap is explicit.
+
+The observability teeth — each cross-check FAILS the run (``ok=False``,
+non-zero CLI exit) when it disagrees beyond its declared tolerance:
+
+- **TTFT vs spans**: for every clean request (single submission, no
+  migration/evacuation/abort), client TTFT must bracket the span-assembled
+  worker timeline (``worker.queue`` + ``engine.prefill`` durations for its
+  trace id): the span time can never exceed client TTFT by more than
+  ``ttft_span_slack_s``, and the median client-over-span overhead must
+  stay under ``ttft_overhead_s``.
+- **tokens vs recorder**: client-counted tokens — Σ over driver-visible
+  submissions of (prompt + received) — reconciled against the summed
+  recorder lifetime ``total_goodput_tokens``. The recorder may legitimately
+  read *low* by two measured credits — prefix-cache hit tokens it never
+  recomputed, and the prefill-sampled first token of each submission —
+  (plus ``token_tol_low``) and *high* by Migration-internal replays and
+  decode-ahead work of cancelled streams (bounded by ``token_tol_high``).
+
+Determinism: ``outcome_digest`` hashes request-level outcomes (tokens,
+abort flags, completion) — same ``REPLAY_SEED`` ⇒ same digest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from benchmarks.datagen import percentile
+
+from ..observability.flops import FlopsModel, peak_flops
+from .driver import ReplayRunResult, RequestOutcome
+from .trace import ReplayTrace, TierSpec
+
+
+@dataclass
+class CheckTolerances:
+    """Declared cross-check tolerances (echoed into the report)."""
+
+    # TTFT check: span timeline may exceed client TTFT by at most this
+    # (clock-read ordering slack), and the median client-over-span
+    # transport/routing overhead must stay under ttft_overhead_s
+    ttft_span_slack_s: float = 0.075
+    ttft_overhead_s: float = 0.5
+    min_ttft_samples: int = 1
+    # token check: recorder vs client tolerance band (fractions of the
+    # client-expected count, after crediting prefix-cache hit tokens)
+    token_tol_low: float = 0.05
+    token_tol_high: float = 0.75
+
+
+def outcome_digest(outcomes: List[RequestOutcome]) -> str:
+    """Order-independent hash of request-level outcomes: same seed ⇒ same
+    tokens, abort/completion flags ⇒ same digest."""
+    payload = sorted(
+        (o.request_id, o.tokens, bool(o.aborted), o.error is None)
+        for o in outcomes
+    )
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def _tier_table(
+    outcomes: List[RequestOutcome], tiers: List[TierSpec],
+    elapsed_s: float,
+) -> Dict[str, dict]:
+    specs = {t.tier: t for t in tiers}
+    table: Dict[str, dict] = {}
+    for tier in sorted({o.tier for o in outcomes}):
+        sub = [o for o in outcomes if o.tier == tier]
+        scored = [o for o in sub
+                  if o.error is None and not o.aborted
+                  and o.finish_reason is not None]
+        ttfts = [o.ttft_s for o in scored if o.ttft_s is not None]
+        itls = [x for o in scored for x in o.itls]
+        out_tokens = sum(len(o.tokens) for o in scored)
+        spec = specs.get(tier)
+        violations = 0
+        if spec is not None:
+            for o in scored:
+                mean_itl = (sum(o.itls) / len(o.itls)) if o.itls else 0.0
+                if ((o.ttft_s or 0.0) > spec.ttft_slo_s
+                        or mean_itl > spec.itl_slo_s):
+                    violations += 1
+        table[str(tier)] = {
+            "requests": len(sub),
+            "completed": len(scored),
+            "aborted": sum(1 for o in sub if o.aborted),
+            "errors": sum(1 for o in sub if o.error is not None),
+            "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 2),
+            "ttft_p99_ms": round(percentile(ttfts, 99) * 1e3, 2),
+            "itl_p50_ms": round(percentile(itls, 50) * 1e3, 2),
+            "itl_p99_ms": round(percentile(itls, 99) * 1e3, 2),
+            "goodput_tokens": out_tokens,
+            "goodput_tok_s": round(out_tokens / max(elapsed_s, 1e-9), 2),
+            "slo": ({"ttft_s": spec.ttft_slo_s, "itl_s": spec.itl_slo_s}
+                    if spec else None),
+            "slo_violation_rate": (
+                round(violations / len(scored), 4) if scored else None),
+        }
+    return table
+
+
+def _span_timelines(spans: List[dict]) -> Dict[str, dict]:
+    """trace_id → stage-duration map, only for traces whose span set is
+    unambiguous (exactly one queue + one prefill span = one engine
+    admission; migrated/evacuated requests have several)."""
+    by_trace: Dict[str, Dict[str, List[float]]] = {}
+    for s in spans:
+        dur = s.get("duration_s")
+        if dur is None:
+            continue
+        by_trace.setdefault(s.get("trace_id", "?"), {}).setdefault(
+            s.get("name", "?"), []).append(float(dur))
+    out: Dict[str, dict] = {}
+    for tid, stages in by_trace.items():
+        if (len(stages.get("worker.queue", [])) == 1
+                and len(stages.get("engine.prefill", [])) == 1):
+            out[tid] = {
+                "queue_s": stages["worker.queue"][0],
+                "prefill_s": stages["engine.prefill"][0],
+                "attempts": len(stages.get("migration.attempt", [])),
+            }
+    return out
+
+
+def cross_check_ttft(
+    outcomes: List[RequestOutcome], spans: List[dict],
+    tol: CheckTolerances,
+) -> dict:
+    """Client TTFT vs span-assembled worker timeline, per clean request."""
+    timelines = _span_timelines(spans)
+    samples = []
+    for o in outcomes:
+        if (o.error is not None or o.aborted or o.resumes
+                or o.reconnects or len(o.submissions) != 1
+                or o.ttft_s is None):
+            continue
+        tl = timelines.get(o.trace_id)
+        if tl is None or tl["attempts"] > 1:
+            continue
+        span_ttft = tl["queue_s"] + tl["prefill_s"]
+        samples.append({
+            "request_id": o.request_id,
+            "client_ttft_s": round(o.ttft_s, 6),
+            "span_ttft_s": round(span_ttft, 6),
+            "overhead_s": round(o.ttft_s - span_ttft, 6),
+        })
+    check = {
+        "samples": len(samples),
+        "tolerance": {"span_slack_s": tol.ttft_span_slack_s,
+                      "overhead_s": tol.ttft_overhead_s,
+                      "min_samples": tol.min_ttft_samples},
+    }
+    if len(samples) < tol.min_ttft_samples:
+        check.update(ok=False, reason=(
+            f"only {len(samples)} span-matched clean requests "
+            f"(need {tol.min_ttft_samples}) — span pipeline broken?"))
+        return check
+    overheads = sorted(s["overhead_s"] for s in samples)
+    median_overhead = overheads[len(overheads) // 2]
+    worst_negative = min(overheads)
+    check.update({
+        "median_overhead_s": round(median_overhead, 6),
+        "min_overhead_s": round(worst_negative, 6),
+        "max_overhead_s": round(overheads[-1], 6),
+    })
+    if worst_negative < -tol.ttft_span_slack_s:
+        check.update(ok=False, reason=(
+            f"span timeline exceeds client TTFT by "
+            f"{-worst_negative:.3f}s (> {tol.ttft_span_slack_s}s slack)"))
+    elif median_overhead > tol.ttft_overhead_s:
+        check.update(ok=False, reason=(
+            f"median client-over-span overhead {median_overhead:.3f}s "
+            f"exceeds {tol.ttft_overhead_s}s"))
+    else:
+        check["ok"] = True
+    return check
+
+
+def cross_check_tokens(
+    outcomes: List[RequestOutcome], recorder_tokens: float,
+    prefix_hit_tokens: float, tol: CheckTolerances,
+) -> dict:
+    """Client-counted tokens vs recorder lifetime goodput totals.
+
+    The recorder counts every token the engines *computed* — prompt tokens
+    per dispatched prefill chunk plus decode-window tokens — so the
+    client-side expectation is Σ over submissions of (prompt + received),
+    minus two measured credits for work the engine legitimately never did:
+    prefix-cache hit tokens (cached blocks skip prefill dispatch) and one
+    token per productive submission (the first output token is sampled by
+    the final prefill chunk, whose goodput already counted as prompt)."""
+    client_expected = float(sum(
+        p + r for o in outcomes for (p, r) in o.submissions))
+    first_token_credit = float(sum(
+        1 for o in outcomes for (_p, r) in o.submissions if r > 0))
+    low = ((client_expected - prefix_hit_tokens - first_token_credit)
+           * (1.0 - tol.token_tol_low))
+    high = client_expected * (1.0 + tol.token_tol_high)
+    check = {
+        "client_expected_tokens": client_expected,
+        "recorder_tokens": recorder_tokens,
+        "prefix_hit_tokens_credit": prefix_hit_tokens,
+        "first_token_credit": first_token_credit,
+        "bounds": [round(low, 1), round(high, 1)],
+        "tolerance": {"low": tol.token_tol_low,
+                      "high": tol.token_tol_high},
+    }
+    if client_expected <= 0:
+        check.update(ok=False, reason="no client-side submissions recorded")
+    elif recorder_tokens < low:
+        check.update(ok=False, reason=(
+            f"recorder {recorder_tokens:.0f} below bound {low:.0f} — "
+            f"engines did less work than clients were billed for"))
+    elif recorder_tokens > high:
+        check.update(ok=False, reason=(
+            f"recorder {recorder_tokens:.0f} above bound {high:.0f} — "
+            f"hidden replay amplification"))
+    else:
+        check["ok"] = True
+    return check
+
+
+def build_scoreboard(
+    trace: ReplayTrace, run: ReplayRunResult,
+    tol: Optional[CheckTolerances] = None,
+) -> dict:
+    """Assemble the full REPLAY_*.json payload from one cluster replay."""
+    tol = tol or CheckTolerances()
+    outcomes = run.outcomes
+    elapsed = max(run.elapsed_s, 1e-9)
+    completed = [o for o in outcomes
+                 if o.error is None and not o.aborted
+                 and o.finish_reason is not None]
+    out_tokens = sum(len(o.tokens) for o in completed)
+    gt = dict(trace.meta.get("prefix_ground_truth") or {})
+    hit_tokens = float(run.prefix_hits_blocks * run.block_size)
+    hit_potential = float(gt.get("prefix_hit_potential_tokens", 0) or 0)
+
+    # $-proxy: measured chip-seconds per 1M output tokens, next to the
+    # analytic roofline for the same token volume (flops of every
+    # completed request at the device's peak)
+    chip_seconds = elapsed * run.chips
+    per_1m = (chip_seconds / (out_tokens / 1e6)) if out_tokens else None
+    peak = peak_flops(run.device_kind, run.platform)
+    try:
+        from ..engine.config import ModelConfig
+
+        fm = FlopsModel(ModelConfig.tiny())
+        ideal_s = sum(
+            fm.sequence_flops(o.isl, max(len(o.tokens), 1))
+            for o in completed
+        ) / peak
+    except Exception:
+        ideal_s = None
+    ideal_per_1m = ((ideal_s / (out_tokens / 1e6))
+                    if (ideal_s is not None and out_tokens) else None)
+
+    checks = {
+        "ttft_vs_spans": cross_check_ttft(outcomes, run.spans, tol),
+        "tokens_vs_recorder": cross_check_tokens(
+            outcomes, run.recorder_goodput_tokens, hit_tokens, tol),
+    }
+    tier_table = _tier_table(outcomes, trace.tiers(), elapsed)
+    violation_rates = [t["slo_violation_rate"] for t in tier_table.values()
+                       if t["slo_violation_rate"] is not None]
+    report = {
+        "replay_seed": run.seed,
+        "outcome_digest": outcome_digest(outcomes),
+        "requests": len(outcomes),
+        "completed": len(completed),
+        "aborted": sum(1 for o in outcomes if o.aborted),
+        "errors": sum(1 for o in outcomes if o.error is not None),
+        "reconnects": sum(o.reconnects for o in outcomes),
+        "evacuation_resumes": sum(o.resumes for o in outcomes),
+        "elapsed_s": round(run.elapsed_s, 3),
+        "time_scale": run.time_scale,
+        "output_tokens": out_tokens,
+        "output_tok_s": round(out_tokens / elapsed, 2),
+        "tiers": tier_table,
+        "slo_violation_rate": (
+            round(sum(
+                t["slo_violation_rate"] * t["completed"]
+                for t in tier_table.values()
+                if t["slo_violation_rate"] is not None
+            ) / max(len(completed), 1), 4)
+            if violation_rates else None),
+        "prefix_hit_tokens": hit_tokens,
+        "prefix_hit_potential_tokens": hit_potential,
+        "prefix_hit_rate": (
+            round(min(hit_tokens / hit_potential, 1.0), 4)
+            if hit_potential else None),
+        "prefix_ground_truth": gt,
+        "events_fired": run.events_fired,
+        "preempt": run.preempt,
+        "num_kills": run.num_kills,
+        "chips": run.chips,
+        "device_kind": run.device_kind,
+        "chip_seconds": round(chip_seconds, 3),
+        "chip_seconds_per_1m_output_tokens": (
+            round(per_1m, 2) if per_1m is not None else None),
+        "ideal_chip_seconds_per_1m_output_tokens": (
+            round(ideal_per_1m, 6) if ideal_per_1m is not None else None),
+        "checks": checks,
+        "ok": all(c.get("ok") for c in checks.values()),
+    }
+    return report

@@ -68,6 +68,47 @@ def stage_breakdown(spans: Iterable[dict]) -> Dict[str, dict]:
     return out
 
 
+def stage_percentiles(spans: Iterable[dict]) -> Dict[str, dict]:
+    """Per-stage (span name) duration percentiles across ALL traces in a
+    span dump — the offline half of the replay scoreboard's TTFT
+    cross-check: client-measured latencies should bracket the
+    queue+prefill stage timings reported here."""
+    durs: Dict[str, List[float]] = {}
+    for s in spans:
+        dur = s.get("duration_s")
+        if dur is None:
+            continue
+        durs.setdefault(s["name"], []).append(dur)
+
+    def pct(vals: List[float], q: float) -> float:
+        vals = sorted(vals)
+        idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+        return vals[idx]
+
+    return {
+        name: {
+            "count": len(vals),
+            "p50_ms": round(pct(vals, 0.50) * 1e3, 3),
+            "p99_ms": round(pct(vals, 0.99) * 1e3, 3),
+            "max_ms": round(max(vals) * 1e3, 3),
+            "total_s": round(sum(vals), 6),
+        }
+        for name, vals in sorted(durs.items())
+    }
+
+
+def render_summary(stages: Dict[str, dict]) -> str:
+    lines = [f"{'stage':<24} {'count':>6} {'p50 ms':>10} {'p99 ms':>10} "
+             f"{'max ms':>10}"]
+    for name, agg in sorted(stages.items(),
+                            key=lambda kv: -kv[1]["total_s"]):
+        lines.append(
+            f"{name:<24} {agg['count']:>6} {agg['p50_ms']:>10.3f} "
+            f"{agg['p99_ms']:>10.3f} {agg['max_ms']:>10.3f}"
+        )
+    return "\n".join(lines)
+
+
 def assemble_trace(spans: List[dict]) -> dict:
     """One trace's spans → {trace_id, duration_s, spans, stages}.
 
@@ -144,7 +185,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="only this trace (default: all, newest last)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit assembled traces as JSON instead of text")
+    p.add_argument("--summary", action="store_true",
+                   help="per-stage p50/p99 across all traces instead of "
+                        "per-trace timelines")
     args = p.parse_args(argv)
+
+    if args.summary:
+        stages = stage_percentiles(load_spans(args.files))
+        if args.as_json:
+            print(json.dumps(stages))
+        else:
+            print(render_summary(stages))
+        return 0
 
     traces = group_traces(load_spans(args.files))
     if args.trace_id is not None:

@@ -42,6 +42,11 @@
 #                                 # with NaN trash blocks, kvbm/disagg
 #                                 # round-trips); echoes the repro line on
 #                                 # failure
+#   scripts/verify.sh replay      # trace-replay scoreboard suite: seeded
+#                                 # multi-tenant replay vs a real-engine
+#                                 # cluster, cross-checked against recorder
+#                                 # + spans; echoes the repro seed
+#                                 # (DYNTPU_REPLAY_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -176,6 +181,23 @@ if [ "${1:-}" = "preempt" ]; then
         echo "preemption suite FAILED; reproduce with e.g.:"
         for s in $seeds; do
             echo "  DYNTPU_${s} scripts/verify.sh preempt"
+        done
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "replay" ]; then
+    set -o pipefail
+    rm -f /tmp/_replay.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m replay \
+        -p no:cacheprovider 2>&1 | tee /tmp/_replay.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every replay test prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'REPLAY_SEED=[0-9]+' /tmp/_replay.log | sort -u | tr '\n' ' ')
+        echo "trace-replay suite FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh replay"
         done
     fi
     exit $rc

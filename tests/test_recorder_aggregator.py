@@ -162,3 +162,99 @@ async def test_aggregator_kvbm_and_preempt_gauges():
         await runtime.shutdown()
     finally:
         await server.stop()
+
+
+async def test_aggregator_replay_gauges_forward_compat():
+    """Recorder lifetime totals (the replay scoreboard's cross-check feed)
+    land as per-worker gauges, zero-default for older workers that publish
+    no ``obs`` block, and sum through ``goodput_tokens_total()``."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        agg = MetricsAggregator(runtime, "backend")
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        # worker 1: an older worker — no obs block at all
+        await runtime.store.publish(subject + "1", msgpack.packb({
+            "worker_id": 1, "kv_usage": 0.1, "num_requests_running": 0,
+            "num_requests_waiting": 0,
+        }))
+        # worker 2: flight-recorder lifetime totals present
+        await runtime.store.publish(subject + "2", msgpack.packb({
+            "worker_id": 2, "kv_usage": 0.2, "num_requests_running": 1,
+            "num_requests_waiting": 0,
+            "obs": {"total_goodput_tokens": 1234.0, "total_steps": 77.0},
+        }))
+        for _ in range(100):
+            if {"1", "2"} <= set(agg.worker_stats):
+                break
+            await asyncio.sleep(0.01)
+        body = runtime.metrics.render().decode()
+        c = 'component="backend"'
+        assert f'worker_goodput_tokens_total{{{c},worker="2"}} 1234' in body
+        assert f'worker_steps_total{{{c},worker="2"}} 77' in body
+        # the obs-less worker zero-defaults instead of going unreported
+        assert f'worker_goodput_tokens_total{{{c},worker="1"}} 0' in body
+        assert f'worker_steps_total{{{c},worker="1"}} 0' in body
+        # worker 1 publishes no recorder, so only worker 2 sums
+        assert agg.goodput_tokens_total() == 1234.0
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
+
+
+async def test_aggregator_replay_gauges_expire_with_worker():
+    """Stale expiry clears the lifetime-total label sets along with every
+    other per-worker gauge — a dead worker must not keep contributing to
+    the replay cross-check feed."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        now = [0.0]
+        agg = MetricsAggregator(runtime, "backend", stale_after_s=5.0,
+                                clock=lambda: now[0])
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        await runtime.store.publish(subject + "3", msgpack.packb({
+            "worker_id": 3, "kv_usage": 0.3, "num_requests_running": 0,
+            "num_requests_waiting": 0,
+            "obs": {"total_goodput_tokens": 50.0, "total_steps": 9.0},
+        }))
+        for _ in range(100):
+            if "3" in agg.worker_stats:
+                break
+            await asyncio.sleep(0.01)
+        body = runtime.metrics.render().decode()
+        assert 'worker_goodput_tokens_total' in body and 'worker="3"' in body
+        assert agg.goodput_tokens_total() == 50.0
+
+        now[0] = 10.0  # silent past stale_after_s
+        agg.expire_stale()
+        body = runtime.metrics.render().decode()
+        assert 'worker="3"' not in body
+        assert agg.goodput_tokens_total() is None
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
