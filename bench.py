@@ -757,7 +757,9 @@ async def run_replay_gate() -> dict:
     from dynamo_tpu.replay.__main__ import scenario_config
     from dynamo_tpu.replay.driver import ReplaySettings, run_cluster_replay
     from dynamo_tpu.replay.scoreboard import build_scoreboard
-    from dynamo_tpu.replay.trace import generate_trace
+    from dynamo_tpu.replay.trace import (
+        generate_gauntlet_trace, generate_trace,
+    )
 
     seed = int(os.environ.get("BENCH_REPLAY_SEED", 0))
     trace = generate_trace(scenario_config("bursty", seed))
@@ -779,6 +781,30 @@ async def run_replay_gate() -> dict:
     for tier, row in sorted(rep["tiers"].items()):
         for key in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms", "itl_p99_ms"):
             fields[f"replay_tier{tier}_{key}"] = row[key]
+
+    # chaos gauntlet alongside the clean replay: seeded fault waves with
+    # attributed-recovery scoring; token loss must be exactly zero
+    chaos_trace = generate_gauntlet_trace(seed)
+    with tempfile.TemporaryDirectory() as workdir:
+        chaos_run = await run_cluster_replay(
+            chaos_trace,
+            ReplaySettings(time_scale=2.0, stall_timeout_s=0.5,
+                           stall_timeout_per_token_s=0.01),
+            workdir=workdir)
+    chaos = build_scoreboard(chaos_trace, chaos_run)
+    fields.update({
+        "chaos_ok": chaos["ok"],
+        "chaos_checks_failed": sorted(
+            k for k, v in chaos["checks"].items() if not v.get("ok")),
+        "chaos_failed_reasons": {
+            k: v.get("reason", "") for k, v in chaos["checks"].items()
+            if not v.get("ok")},
+        "chaos_digest": chaos["outcome_digest"],
+        "chaos_faults_fired": sum(chaos["faults_fired"].values()),
+        "chaos_slo_violation_rate": chaos["chaos_slo_violation_rate"],
+        "chaos_recovery_windows_p99": chaos["chaos_recovery_windows_p99"],
+        "chaos_token_loss": chaos["chaos_token_loss"],
+    })
     return fields
 
 

@@ -827,8 +827,18 @@ class EngineCore(AsyncEngine):
 
     def _arm_stall_fault(self, batch) -> None:
         """Fault-registry seam: a ``delay`` rule on ``engine.stall`` wedges
-        this window's landing for delay_s, as a hung device dispatch would."""
-        rule = faults.active("engine.stall", str(next(self._window_seq)))
+        this window's landing for delay_s, as a hung device dispatch would.
+        The key leads with the window kind (``decode``/``prefill``/``mixed``)
+        so a rule can pin the wedge to a window class: the watchdog deadline
+        scales with scheduled tokens, so only a wedge longer than that
+        window's deadline is ever *detected* — matching ``decode`` keeps a
+        finite delay reliably above the (small) pure-decode deadline."""
+        if batch.prefills:
+            kind = "mixed" if batch.decode_rows else "prefill"
+        else:
+            kind = "decode"
+        rule = faults.active(
+            "engine.stall", f"{kind}:{next(self._window_seq)}")
         if rule is not None and rule.kind == faults.DELAY:
             batch.stall_inject_s = rule.delay_s
 

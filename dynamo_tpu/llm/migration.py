@@ -50,6 +50,11 @@ class Migration(AsyncEngine):
         self.backoff_cap_s = backoff_cap_s
         # injectable for deterministic jitter in tests
         self.rng = rng or random.Random()
+        # re-issue decisions taken over this sink's lifetime: the direct
+        # stream-repair evidence the replay fault-attribution check reads
+        # (span surplus undercounts when an unrelated request's timeout
+        # cancels its attempt span before export)
+        self.num_retries = 0
 
     async def _backoff(self, attempt: int, context: Context) -> bool:
         """Sleep the jittered backoff for retry number ``attempt`` (1-based),
@@ -125,6 +130,7 @@ class Migration(AsyncEngine):
                     )
                 attempts_left -= 1
                 attempt += 1
+                self.num_retries += 1
                 with trace_span("migration.backoff", context,
                                 attrs={"attempt": attempt}):
                     backed_off = await self._backoff(attempt, context)

@@ -154,6 +154,21 @@ class MetricsAggregator:
             "worker_preempt_evacuated_total",
             "per-worker seats evacuated to a peer", ["worker"]
         )
+        # chaos visibility ("faults" key): per-worker fault-plan firings so
+        # a replay's attribution cross-check can read the live deployment
+        self._g_faults_fired = m.gauge(
+            "worker_faults_fired_total",
+            "per-worker injected-fault firings by site and kind",
+            ["worker", "site", "kind"]
+        )
+        # (site, kind) label sets seen per worker — expire_stale must drop
+        # exactly these, and absent sites must re-zero, not freeze
+        self._fault_labels: Dict[str, set] = {}
+        self._g_wave_recovery = m.gauge(
+            "replay_wave_recovery_windows",
+            "windows until per-tier SLO compliance returned after a "
+            "replayed fault wave (-1 while unrecovered)", ["wave"]
+        )
         self._c_events = m.counter(
             "kv_events_total", "KV events seen", ["kind"]
         )
@@ -278,6 +293,20 @@ class MetricsAggregator:
             pe.get("notices", 0.0))
         self._g_preempt_evacuated.labels(worker=wid).set(
             pe.get("evacuated_total", 0.0))
+        # forward-compat: workers without an installed fault plan publish
+        # no "faults" — zero-default every label set seen so far rather
+        # than freezing stale firings after a plan is cleared
+        fired = snap.get("faults") or {}
+        labels = self._fault_labels.setdefault(wid, set())
+        for key, count in fired.items():
+            site, _, kind = key.partition("/")
+            labels.add((site, kind))
+            self._g_faults_fired.labels(
+                worker=wid, site=site, kind=kind).set(count)
+        for site, kind in labels:
+            if f"{site}/{kind}" not in fired:
+                self._g_faults_fired.labels(
+                    worker=wid, site=site, kind=kind).set(0.0)
         self.expire_stale()
         self._recompute_hit_rate()
         self._recompute_spec_rate()
@@ -302,6 +331,9 @@ class MetricsAggregator:
                           self._g_kvbm_peer_hits, self._g_preempt_notices,
                           self._g_preempt_evacuated):
                 gauge.remove(worker=wid)
+            for site, kind in self._fault_labels.pop(wid, set()):
+                self._g_faults_fired.remove(
+                    worker=wid, site=site, kind=kind)
             log.info("expired stale worker %s from the scrape", wid)
 
     def _recompute_hit_rate(self) -> None:
@@ -345,6 +377,17 @@ class MetricsAggregator:
                 kind="preemption",
                 detail=str(event.get("worker") or event.get("notices")
                            or "notice"),
+            ).inc()
+        elif kind == "replay_wave":
+            # a chaos replay scored one fault wave: publish its recovery
+            # verdict so dashboards overlay it on the worker gauges
+            # (-1 = the tiers never got back under SLO in this run)
+            windows = event.get("windows_to_recover")
+            self._g_wave_recovery.labels(
+                wave=str(event.get("wave", "?"))
+            ).set(-1.0 if windows is None else float(windows))
+            self._c_transitions.labels(
+                kind="replay_wave", detail=str(event.get("wave", "?"))
             ).inc()
 
     def queue_depth(self) -> int:

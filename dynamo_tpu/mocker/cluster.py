@@ -239,6 +239,12 @@ class SimCluster:
         return sorted(w.wid for w in self._workers.values()
                       if w.component == component)
 
+    def worker_addr(self, worker_id: int) -> str:
+        """Advertised ingress address of a live worker — the in-process
+        analogue of a live deployment's worker admin URL, so replay code
+        can address fault/preempt events at a specific seeded victim."""
+        return self._workers[worker_id].served.instance.addr
+
     async def spawn(self, component: str) -> int:
         rt = await DistributedRuntime.from_settings(self.cfg)
         engine = (self.engine_factory() if self.engine_factory is not None
@@ -1057,6 +1063,7 @@ class DisaggChaosHarness:
                               if self.queue_worker is not None else 0),
             "breaker_trips": dh.fallback_breaker.num_trips,
             "faults_fired": plan.fired(),
+            "faults_fired_by_site": plan.fired_counts(),
             "canary_corrupted": canary_corrupted,
             "leaked_blocks": leaked_blocks,
             "leaked_blocks_prefill": leaked_prefill,
@@ -1491,6 +1498,7 @@ class PreemptionChaosHarness:
                 self.peer.kvbm.stats.onboarded_blocks
                 if self.peer.kvbm is not None else 0),
             "faults_fired": plan.fired(),
+            "faults_fired_by_site": plan.fired_counts(),
             "canary_corrupted": canary_corrupted,
             "leaked_blocks": leaked_src + leaked_peer,
             "leaked_blocks_src": leaked_src,

@@ -18,7 +18,10 @@ import sys
 
 from .driver import ReplaySettings, run_cluster_replay
 from .scoreboard import build_scoreboard
-from .trace import TraceConfig, dump_jsonl, generate_trace, load_jsonl
+from .trace import (
+    TraceConfig, dump_jsonl, generate_gauntlet_trace, generate_trace,
+    load_jsonl,
+)
 
 
 def scenario_config(name: str, seed: int) -> TraceConfig:
@@ -56,7 +59,7 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int,
                    default=int(os.environ.get("DYNTPU_REPLAY_SEED", "0")))
     p.add_argument("--scenario", default="bursty",
-                   choices=["smoke", "bursty", "flagship"])
+                   choices=["smoke", "bursty", "flagship", "gauntlet"])
     p.add_argument("--trace-in", default=None,
                    help="replay a JSONL trace file instead of generating")
     p.add_argument("--trace-out", default=None,
@@ -72,6 +75,8 @@ def main(argv=None) -> int:
 
     if args.trace_in:
         trace = load_jsonl(args.trace_in)
+    elif args.scenario == "gauntlet":
+        trace = generate_gauntlet_trace(args.seed)
     else:
         trace = generate_trace(scenario_config(args.scenario, args.seed))
     if args.trace_out:
@@ -79,6 +84,11 @@ def main(argv=None) -> int:
 
     settings = ReplaySettings(time_scale=args.time_scale,
                               n_workers=args.workers)
+    if args.scenario == "gauntlet" and not args.trace_in:
+        # arm the stall watchdog so the stallwave's injected wedge trips a
+        # real quarantine the attribution check can see
+        settings.stall_timeout_s = 0.5
+        settings.stall_timeout_per_token_s = 0.01
     run = asyncio.run(run_cluster_replay(trace, settings,
                                          workdir=args.out))
     report = build_scoreboard(trace, run)
@@ -102,7 +112,16 @@ def main(argv=None) -> int:
         for name, chk in report["checks"].items():
             state = "ok" if chk.get("ok") else f"FAIL: {chk.get('reason')}"
             print(f"check {name}: {state}")
-    # repro line (grepped by scripts/verify.sh replay on failure)
+        if report.get("faults_fired"):
+            print(f"faults_fired="
+                  f"{json.dumps(report['faults_fired'], sort_keys=True)}")
+            print(f"chaos: slo_viol={report['chaos_slo_violation_rate']}"
+                  f" recovery_p99={report['chaos_recovery_windows_p99']}"
+                  f" token_loss={report['chaos_token_loss']}")
+    # repro lines (grepped by scripts/verify.sh replay/chaosreplay on
+    # failure; CHAOS_SEED and REPLAY_SEED are the same knob)
+    if args.scenario == "gauntlet":
+        print(f"CHAOS_SEED={trace.seed}")
     print(f"REPLAY_SEED={trace.seed}")
     return 0 if report["ok"] else 1
 

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from ..llm.migration import Migration
 from ..router.kv_router import KvPushRouter, KvRouter
 from ..router.scheduler import KvRouterConfig
+from ..runtime import faults
 from ..runtime.circuit import BreakerConfig, CircuitBreakerRegistry
 from ..runtime.component import DistributedRuntime
 from ..runtime.context import Context
@@ -79,6 +80,10 @@ class ReplaySettings:
     # max extra wall wait for an evacuable decode seat before a scheduled
     # "preempt" event sends its notice (0 = fire exactly on schedule)
     preempt_wait_s: float = 8.0
+    # stall watchdog (off by default, matching EngineConfig); gauntlet
+    # scenarios arm it so engine.stall delay faults trip real quarantines
+    stall_timeout_s: float = 0.0
+    stall_timeout_per_token_s: float = 0.0
 
 
 @dataclass
@@ -132,6 +137,12 @@ class ReplayRunResult:
     preempt: Dict[str, int]
     num_kills: int
     seed: int
+    # chaos track: plan firing counts (``site/kind`` → n), the full firing
+    # log, and the observability evidence counters the fault-attribution
+    # cross-check reconciles the firings against
+    faults_fired: Dict[str, int] = field(default_factory=dict)
+    fault_log: List[dict] = field(default_factory=list)
+    evidence: Dict[str, float] = field(default_factory=dict)
 
 
 async def _drive_one(
@@ -229,7 +240,25 @@ async def run_cluster_replay(
 ) -> ReplayRunResult:
     """Replay ``trace`` against an in-process real-engine SimCluster and
     return outcomes plus the engine-internal truth the scoreboard
-    cross-checks against."""
+    cross-checks against.
+
+    A fresh :class:`faults.FaultPlan` seeded from the trace is installed
+    for the whole run; ``fault`` events on the trace's event track append
+    their wave's rules to it (the in-process twin of POSTing the wave to a
+    live worker's ``/debug/faults``), and its firing log is returned for
+    the scoreboard's fault-attribution cross-check."""
+    plan = faults.FaultPlan(seed=trace.seed)
+    faults.install(plan)
+    try:
+        return await _cluster_replay(trace, settings, workdir, plan)
+    finally:
+        faults.clear()
+
+
+async def _cluster_replay(
+    trace: ReplayTrace, settings: Optional[ReplaySettings],
+    workdir: str, plan: faults.FaultPlan,
+) -> ReplayRunResult:
     from ..engine.config import EngineConfig, ModelConfig
     from ..engine.engine import InferenceEngine
     from ..runtime.preemption import PreemptionCoordinator
@@ -252,6 +281,8 @@ async def run_cluster_replay(
         max_num_batched_tokens=settings.max_num_batched_tokens,
         prefill_buckets=(settings.max_num_batched_tokens,),
         decode_buckets=(4, 8), max_num_seqs=settings.max_num_seqs,
+        stall_timeout_s=settings.stall_timeout_s,
+        stall_timeout_per_token_s=settings.stall_timeout_per_token_s,
     )
 
     def _engine() -> InferenceEngine:
@@ -320,7 +351,9 @@ async def run_cluster_replay(
     mem.clear()
 
     # retired-worker accumulators: totals harvested just before a kill
-    retired = {"goodput": 0.0, "steps": 0.0, "hits": 0, "queries": 0}
+    retired = {"goodput": 0.0, "steps": 0.0, "hits": 0, "queries": 0,
+               "stalls": 0.0, "store_recoveries": 0.0,
+               "store_call_errors": 0.0}
     preempt_counts = {"notices": 0, "evacuated_peer": 0, "spilled": 0,
                       "fallbacks": 0, "seats": 0}
     events_fired: List[dict] = []
@@ -330,10 +363,15 @@ async def run_cluster_replay(
         obs = eng.obs_snapshot() or {}
         retired["goodput"] += float(obs.get("total_goodput_tokens", 0.0))
         retired["steps"] += float(obs.get("total_steps", 0.0))
+        retired["stalls"] += float(obs.get("stalls_total", 0.0))
         st = eng.scheduler.stats
         base = prefix_base.pop(wid, (0, 0))
         retired["hits"] += st.prefix_cache_hits - base[0]
         retired["queries"] += st.prefix_cache_queries - base[1]
+        rt = cluster._workers[wid].runtime
+        retired["store_recoveries"] += float(rt.store.num_recoveries)
+        retired["store_call_errors"] += float(
+            getattr(rt.store, "num_call_errors", 0))
 
     loop = asyncio.get_running_loop()
     t0 = loop.time()
@@ -343,17 +381,51 @@ async def run_cluster_replay(
             delay = t0 + ev.at_s / scale - loop.time()
             if delay > 0:
                 await asyncio.sleep(delay)
-            wids = cluster.workers(cluster.decode_component)
+            wids = sorted(cluster.workers(cluster.decode_component))
             fired = {"kind": ev.kind, "at_s": ev.at_s}
-            if ev.kind == "preempt" and wids:
+            if ev.kind == "fault":
+                # in-process twin of POSTing the wave to /debug/faults:
+                # the wave's rules land on the one process-global plan
+                wave = str(ev.params.get("wave", ""))
+                rules = list(ev.params.get("rules", []))
+                added = [faults.FaultRule.from_dict(dict(rd))
+                         for rd in rules]
+                for rule in added:
+                    plan.add(rule)
+                # clock-gated rules (lease keepalives tick on a wall-clock
+                # phase set at spawn) are kicked through the addressed
+                # worker's client so the firing count is exactly ``times``
+                # every run — the live /debug/faults install does the same
+                if wids:
+                    widx = int(ev.params.get("worker_index", 0))
+                    rt = cluster._workers[wids[widx % len(wids)]].runtime
+                    for rule in added:
+                        if (rule.site == "store.call"
+                                and rule.match == "lease_keepalive"):
+                            for _ in range(max(1, int(rule.times or 1))):
+                                await rt.store.kick_keepalive()
+                fired["wave"] = wave
+                fired["rules"] = len(rules)
+            elif ev.kind == "fault_clear":
+                wave = str(ev.params.get("wave", ""))
+                fired["wave"] = wave
+                fired["removed"] = plan.clear_wave(wave)
+            elif ev.kind == "preempt" and wids:
+                # addressed victim: the trace's seeded worker_index maps
+                # onto the sorted worker list — the same arithmetic the
+                # live-HTTP driver uses, so both modes preempt identical
+                # victims under one seed. The scheduled offset can land
+                # while everything is still queued or mid-prefill (CPU
+                # replays run far slower than the trace clock), so wait —
+                # bounded — for a decode seat worth evacuating.
                 if "worker_index" in ev.params:
                     wid = wids[int(ev.params["worker_index"]) % len(wids)]
+                    deadline = loop.time() + settings.preempt_wait_s
+                    while (loop.time() < deadline
+                           and not _engine_of(wid).evacuable_seats()):
+                        await asyncio.sleep(0.05)
                 else:
-                    # maintenance hits the busiest worker. The scheduled
-                    # offset can land while everything is still queued or
-                    # mid-prefill (CPU replays run far slower than the
-                    # trace clock), so wait — bounded — for a decode seat
-                    # whose KV is actually worth evacuating.
+                    # legacy traces without targeting: busiest worker
                     deadline = loop.time() + settings.preempt_wait_s
                     while (loop.time() < deadline
                            and not any(_engine_of(w).evacuable_seats()
@@ -426,6 +498,9 @@ async def run_cluster_replay(
     goodput = retired["goodput"]
     steps = retired["steps"]
     hits, queries = retired["hits"], retired["queries"]
+    stalls = retired["stalls"]
+    store_recoveries = retired["store_recoveries"]
+    store_call_errors = retired["store_call_errors"]
     chips = 0
     device_kind, platform = "cpu", "cpu"
     for wid in cluster.workers(cluster.decode_component):
@@ -433,18 +508,46 @@ async def run_cluster_replay(
         obs = eng.obs_snapshot() or {}
         goodput += float(obs.get("total_goodput_tokens", 0.0))
         steps += float(obs.get("total_steps", 0.0))
+        stalls += float(obs.get("stalls_total", 0.0))
         st = eng.scheduler.stats
         base = prefix_base.get(wid, (0, 0))
         hits += st.prefix_cache_hits - base[0]
         queries += st.prefix_cache_queries - base[1]
+        rt = cluster._workers[wid].runtime
+        store_recoveries += float(rt.store.num_recoveries)
+        store_call_errors += float(getattr(rt.store, "num_call_errors", 0))
         dev = eng.mesh.devices.flat[0]
         chips += int(eng.mesh.devices.size)
         device_kind = getattr(dev, "device_kind", "cpu")
         platform = getattr(dev, "platform", "cpu")
+    store_recoveries += float(front.store.num_recoveries)
+    store_call_errors += float(getattr(front.store, "num_call_errors", 0))
 
     spans = [s.to_dict()
              for group in mem.by_trace().values() for s in group]
     get_tracer().remove_exporter(mem)
+
+    # observability evidence the fault-attribution cross-check reconciles
+    # the plan's firing log against (chaos the stack cannot see is a bug)
+    attempt_spans = sum(
+        1 for s in spans if s.get("name") == "migration.attempt")
+    evidence = {
+        "migration_attempts": float(attempt_spans),
+        # the sink's own re-issue counter, not span-surplus arithmetic: a
+        # timed-out request's cancelled attempt span never exports, which
+        # would silently eat the surplus a real repair retry produced
+        "migration_retries": float(mig.num_retries),
+        "breaker_trips": float(sum(
+            b.num_trips for b in breakers._breakers.values())),
+        "store_recoveries": store_recoveries,
+        "store_call_errors": store_call_errors,
+        "engine_stalls": stalls,
+        "preempt_notices": float(preempt_counts["notices"]),
+        "preempt_fallbacks": float(preempt_counts["fallbacks"]),
+        "preempt_spilled": float(preempt_counts["spilled"]),
+        "preempt_evacuated": float(preempt_counts["evacuated_peer"]),
+        "disagg_fallbacks": 0.0,  # no disagg pair in this deployment
+    }
 
     await router.stop()
     await client.stop()
@@ -469,58 +572,319 @@ async def run_cluster_replay(
         preempt=preempt_counts,
         num_kills=cluster.num_kills,
         seed=trace.seed,
+        faults_fired=plan.fired_counts(),
+        fault_log=[{"site": e.site, "key": e.key, "kind": e.kind,
+                    "wave": e.wave} for e in plan.log],
+        evidence=evidence,
     )
 
 
 # ------------------------------ HTTP target ------------------------------
 
 
+@dataclass
+class HttpReplayResult:
+    """Client-side outcomes of a live-deployment replay plus the chaos
+    bookkeeping harvested from the deployment's ``/debug/faults`` admin
+    endpoints (the live twin of ``ReplayRunResult.faults_fired``)."""
+
+    outcomes: List[RequestOutcome]
+    elapsed_s: float
+    time_scale: float
+    events_fired: List[dict]
+    faults_fired: Dict[str, int] = field(default_factory=dict)
+    fault_log: List[dict] = field(default_factory=list)
+    seed: int = 0
+
+
+# sites that execute in the frontend process (its transport dials workers,
+# its StoreClient talks discovery) — every other site lives worker-side
+_FRONTEND_SITE_PREFIXES = ("client.",)
+
+
+async def _drive_one_http(
+    session, url: str, model: str, req: TraceRequest,
+    outcome: RequestOutcome, loop: asyncio.AbstractEventLoop,
+    resume_limit: int, timeout_s: float,
+) -> None:
+    """HTTP twin of :func:`_drive_one`: stream one ``/v1/completions``
+    request, honouring abort/reconnect behaviour and re-issuing when the
+    stream finishes early. The OpenAI layer maps engine ``evacuated`` /
+    ``cancelled`` reasons to ``stop``; with ``ignore_eos`` a ``stop``
+    before the budget is spent can only mean an engine-side early finish,
+    so the driver re-issues with the remaining budget (token ids are not
+    recoverable from SSE text, so the re-issue repeats the original
+    prompt). Token counts come from the final chunk's ``usage``."""
+    import json as _json
+
+    import aiohttp
+
+    budget = req.osl
+    total = 0
+    abort_at = req.abort_after_tokens
+    reconnect_at = req.reconnect_after_tokens
+    t0 = loop.time()
+    prev: Optional[float] = None
+    try:
+        for _submission in range(resume_limit + 1):
+            body = {"model": model, "prompt": req.token_ids,
+                    "max_tokens": budget, "ignore_eos": True,
+                    "stream": True}
+            reason: Optional[str] = None
+            chunks = 0
+            usage_tokens: Optional[int] = None
+            dropped = False
+            async with session.post(
+                f"{url}/v1/completions", json=body,
+                timeout=aiohttp.ClientTimeout(total=timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    outcome.error = f"http {resp.status}"
+                    break
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if (not line.startswith("data: ")
+                            or line == "data: [DONE]"):
+                        continue
+                    payload = _json.loads(line[6:])
+                    choice = payload["choices"][0]
+                    now = loop.time()
+                    if choice.get("text"):
+                        chunks += 1
+                        if outcome.ttft_s is None:
+                            outcome.ttft_s = now - t0
+                        elif prev is not None and now > prev:
+                            outcome.itls.append(now - prev)
+                        prev = now
+                    usage = payload.get("usage")
+                    if usage:
+                        usage_tokens = int(
+                            usage.get("completion_tokens", chunks))
+                    if choice.get("finish_reason"):
+                        reason = choice["finish_reason"]
+                        break
+                    n_total = total + chunks
+                    if abort_at is not None and n_total >= abort_at:
+                        outcome.aborted = True
+                        break
+                    if (reconnect_at is not None
+                            and n_total >= reconnect_at):
+                        reconnect_at = None
+                        outcome.reconnects += 1
+                        dropped = True
+                        break
+            sub_tokens = usage_tokens if usage_tokens is not None else chunks
+            outcome.submissions.append((len(req.token_ids), sub_tokens))
+            total += sub_tokens
+            if outcome.error is not None:
+                break
+            if outcome.aborted:
+                outcome.finish_reason = "aborted"
+                break
+            if reason == "length":
+                outcome.finish_reason = "length"
+                break
+            if reason == "stop" or dropped:
+                if reason == "stop":
+                    outcome.resumes += 1
+                budget = req.osl - total
+                if budget <= 0:
+                    outcome.finish_reason = "length"
+                    break
+                continue
+            if reason is not None:
+                outcome.finish_reason = reason
+                break
+            outcome.error = "stream ended without finish frame"
+            break
+        else:
+            outcome.error = "resume limit exhausted"
+    except Exception as exc:  # noqa: BLE001 — per-request isolation
+        outcome.error = f"{type(exc).__name__}: {exc}"
+    outcome.tokens = list(range(total))  # count only over HTTP
+    outcome.end_s = loop.time() - t0
+
+
 async def run_http_replay(
     trace: ReplayTrace, url: str, model: str = "mock",
     time_scale: float = 1.0, timeout_s: float = 300.0,
-) -> List[RequestOutcome]:
-    """Replay against a live HTTP frontend with loadgen's streaming SSE
-    measurement. Client-side outcomes only: the span/recorder halves of
-    the cross-check come from the deployment's own exporters (span JSONL →
-    ``python -m dynamo_tpu.tracing --summary``, recorder totals → the
-    aggregator's ``worker_goodput_tokens_total``)."""
-    import aiohttp
+    resume_limit: int = 4,
+    worker_admin_urls: Optional[List[str]] = None,
+    frontend_admin_url: Optional[str] = None,
+) -> HttpReplayResult:
+    """Replay against a live HTTP frontend with streaming SSE measurement.
 
-    from benchmarks.datagen import RequestRecord
-    from benchmarks.loadgen import run_one
+    With admin URLs (each process's system server base URL), the full
+    event track runs against the live deployment: ``fault`` events ship
+    each wave's rules over ``POST /debug/faults`` — ``client.*`` sites to
+    the frontend, worker-scoped sites to the worker addressed by the
+    event's seeded ``worker_index`` (the same ``index % n_workers``
+    arithmetic the SimCluster driver uses, so both modes pick identical
+    victims) — ``fault_clear`` retires a wave everywhere, and ``preempt``
+    POSTs the addressed worker's ``/preempt``. ``kill_worker`` and
+    ``store_flap`` need process control the HTTP driver does not have and
+    are recorded as skipped.
+
+    Fault firings are harvested from every admin endpoint (last-known
+    snapshot survives a worker that drains away after its preemption);
+    the span/recorder halves of the scoreboard cross-check still come
+    from the deployment's own exporters."""
+    import aiohttp
 
     scale = max(time_scale, 1e-6)
     loop = asyncio.get_running_loop()
+    worker_admin_urls = [u.rstrip("/") for u in (worker_admin_urls or [])]
+    frontend_admin_url = (frontend_admin_url.rstrip("/")
+                          if frontend_admin_url else None)
+    admins: List[str] = list(worker_admin_urls)
+    if frontend_admin_url:
+        admins.append(frontend_admin_url)
+    # last successful /debug/faults snapshot per admin endpoint
+    admin_state: Dict[str, dict] = {}
+    events_fired: List[dict] = []
+
     outcomes: List[RequestOutcome] = []
-    records: List[RequestRecord] = []
     for r in trace.requests:
         outcomes.append(RequestOutcome(
             request_id=r.request_id, tenant=r.tenant, pool=r.pool,
             tier=r.tier, isl=r.isl, osl=r.osl, arrival_s=r.arrival_s,
         ))
-        records.append(RequestRecord(start=0.0, tier=r.tier))
+
     t0 = loop.time()
     async with aiohttp.ClientSession() as session:
+
+        async def _harvest_admin(target: str) -> bool:
+            try:
+                async with session.get(
+                    f"{target}/debug/faults",
+                    timeout=aiohttp.ClientTimeout(total=5.0),
+                ) as resp:
+                    d = await resp.json()
+            except Exception:
+                return False
+            if d.get("installed"):
+                admin_state[target] = d
+            return True
+
+        async def _events() -> None:
+            for ev in trace.events:
+                delay = t0 + ev.at_s / scale - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                fired: dict = {"kind": ev.kind, "at_s": ev.at_s}
+                widx = int(ev.params.get("worker_index", 0))
+                if ev.kind == "fault" and admins:
+                    wave = str(ev.params.get("wave", ""))
+                    by_target: Dict[str, list] = {}
+                    for rd in ev.params.get("rules", []):
+                        site = str(rd.get("site", ""))
+                        if (site.startswith(_FRONTEND_SITE_PREFIXES)
+                                and frontend_admin_url):
+                            target = frontend_admin_url
+                        elif worker_admin_urls:
+                            target = worker_admin_urls[
+                                widx % len(worker_admin_urls)]
+                        elif frontend_admin_url:
+                            target = frontend_admin_url
+                        else:
+                            continue
+                        by_target.setdefault(target, []).append(dict(rd))
+                    fired["wave"] = wave
+                    fired["installed"] = []
+                    for target, rds in by_target.items():
+                        body = {"schema": faults.SCHEMA_VERSION,
+                                "seed": trace.seed, "draws": 0,
+                                "rules": rds}
+                        try:
+                            async with session.post(
+                                f"{target}/debug/faults", json=body,
+                                timeout=aiohttp.ClientTimeout(total=5.0),
+                            ) as resp:
+                                fired["installed"].append(
+                                    [target, resp.status])
+                        except Exception as exc:
+                            fired["installed"].append(
+                                [target, f"error: {exc}"])
+                elif ev.kind == "fault_clear":
+                    wave = str(ev.params.get("wave", ""))
+                    fired["wave"] = wave
+                    for target in admins:
+                        await _harvest_admin(target)  # log before retiring
+                        try:
+                            async with session.delete(
+                                f"{target}/debug/faults",
+                                params={"wave": wave},
+                                timeout=aiohttp.ClientTimeout(total=5.0),
+                            ):
+                                pass
+                        except Exception:
+                            pass
+                elif ev.kind == "preempt" and worker_admin_urls:
+                    target = worker_admin_urls[widx % len(worker_admin_urls)]
+                    fired["worker"] = target
+                    try:
+                        async with session.post(
+                            f"{target}/preempt",
+                            timeout=aiohttp.ClientTimeout(total=5.0),
+                        ) as resp:
+                            fired["status"] = resp.status
+                    except Exception as exc:
+                        fired["error"] = str(exc)
+                    # the worker drains away after evacuating — keep
+                    # polling its fault log so the firings survive
+                    deadline = loop.time() + 5.0
+                    while loop.time() < deadline:
+                        if not await _harvest_admin(target):
+                            break
+                        await asyncio.sleep(0.1)
+                else:
+                    fired["skipped"] = (
+                        f"no process control over {ev.kind!r} in HTTP mode")
+                events_fired.append(fired)
+                log.info("http replay event fired: %s", fired)
 
         async def _fire(i: int) -> None:
             r = trace.requests[i]
             delay = t0 + r.arrival_s / scale - loop.time()
             if delay > 0:
                 await asyncio.sleep(delay)
+            await _drive_one_http(session, url, model, r, outcomes[i],
+                                  loop, resume_limit, timeout_s)
 
-            class _Gen:
-                token_ids = r.token_ids
-
-            await run_one(session, url, model, _Gen(), r.osl, records[i],
-                          timeout_s=timeout_s)
-
+        events_task = asyncio.create_task(_events())
         await asyncio.gather(*(_fire(i) for i in range(len(trace.requests))))
-    for out, rec in zip(outcomes, records):
-        out.ttft_s = rec.ttft
-        out.itls = rec.itls
-        out.end_s = (rec.end - rec.start) if rec.end else None
-        out.tokens = list(range(rec.output_tokens))  # count only over HTTP
-        out.error = rec.error
-        out.finish_reason = None if rec.error else "length"
-        out.submissions = [(out.isl, rec.output_tokens)]
-    return outcomes
+        await events_task
+        elapsed = loop.time() - t0
+
+        # final harvest + cleanup (dead admins keep their last snapshot)
+        for target in admins:
+            await _harvest_admin(target)
+            try:
+                async with session.delete(
+                    f"{target}/debug/faults",
+                    timeout=aiohttp.ClientTimeout(total=5.0),
+                ):
+                    pass
+            except Exception:
+                pass
+
+    faults_fired: Dict[str, int] = {}
+    fault_log: List[dict] = []
+    for target in admins:
+        d = admin_state.get(target)
+        if not d:
+            continue
+        for k, v in (d.get("fired_counts") or {}).items():
+            faults_fired[k] = faults_fired.get(k, 0) + int(v)
+        for e in (d.get("plan") or {}).get("log", []):
+            fault_log.append({**e, "admin": target})
+
+    return HttpReplayResult(
+        outcomes=outcomes,
+        elapsed_s=elapsed,
+        time_scale=time_scale,
+        events_fired=events_fired,
+        faults_fired=faults_fired,
+        fault_log=fault_log,
+        seed=trace.seed,
+    )

@@ -30,6 +30,20 @@ non-zero CLI exit) when it disagrees beyond its declared tolerance:
   (plus ``token_tol_low``) and *high* by Migration-internal replays and
   decode-ahead work of cancelled streams (bounded by ``token_tol_high``).
 
+Robustness verdicts (the chaos-replay gauntlet):
+
+- **token loss**: every accepted request must end completed-at-budget
+  (possibly via migration/evacuation resume), client-aborted, or cleanly
+  errored — anything else is silent token loss and fails the run;
+- **fault attribution**: every fault the plan fired must surface in the
+  observability evidence (``SITE_EVIDENCE``: migration retries, breaker
+  trips, store recovery/call-error counters, preemption reports, stall
+  quarantines) — chaos the stack cannot see is itself a defect;
+- **per-wave recovery**: for each fault wave / structural chaos event,
+  trace-clock windows until per-tier SLO compliance returns, reported per
+  tier plus ``chaos_recovery_windows_p99`` / ``chaos_slo_violation_rate``
+  / ``chaos_token_loss`` headline fields.
+
 Determinism: ``outcome_digest`` hashes request-level outcomes (tokens,
 abort flags, completion) — same ``REPLAY_SEED`` ⇒ same digest.
 """
@@ -140,6 +154,173 @@ def _span_timelines(spans: List[dict]) -> Dict[str, dict]:
     return out
 
 
+# Observability evidence that can attribute each fault site's firings
+# (keys into ``ReplayRunResult.evidence``). A fired fault none of whose
+# mapped counters moved is chaos the stack cannot see — a defect.
+SITE_EVIDENCE: Dict[str, tuple] = {
+    "client.connect": ("migration_retries", "breaker_trips"),
+    "client.send": ("migration_retries", "breaker_trips"),
+    "worker.admit": ("migration_retries", "breaker_trips"),
+    "worker.stream": ("migration_retries",),
+    "store.call": ("store_call_errors",),
+    "store.connect": ("store_recoveries",),
+    "store.watch": ("store_recoveries",),
+    "disagg.prefill": ("disagg_fallbacks",),
+    "disagg.transfer": ("disagg_fallbacks",),
+    "disagg.inject": ("disagg_fallbacks",),
+    "preempt.notice": ("preempt_notices",),
+    "preempt.evacuate": ("preempt_evacuated", "preempt_spilled",
+                         "preempt_fallbacks"),
+    "engine.stall": ("engine_stalls",),
+}
+
+# kind-specific overrides where the generic site evidence cannot move:
+# a DROPPED maintenance notice means the coordinator never ran — the
+# evidence is the cold-kill recovery machinery instead
+SITE_KIND_EVIDENCE: Dict[tuple, tuple] = {
+    ("preempt.notice", "drop"): ("migration_retries", "breaker_trips"),
+}
+
+
+def cross_check_fault_attribution(
+    faults_fired: Dict[str, int], evidence: Dict[str, float],
+) -> dict:
+    """Every fault the plan fired must surface in the observability
+    evidence (spans, recorder counters, preemption reports) or the run
+    fails — silent chaos is itself a defect."""
+    unattributed = []
+    detail: Dict[str, dict] = {}
+    for key in sorted(faults_fired):
+        count = faults_fired[key]
+        if count <= 0:
+            continue
+        site, _, kind = key.partition("/")
+        ev_keys = (SITE_KIND_EVIDENCE.get((site, kind))
+                   or SITE_EVIDENCE.get(site))
+        if not ev_keys:
+            unattributed.append(f"{key} (no evidence mapping)")
+            continue
+        seen = {k: evidence.get(k, 0.0) for k in ev_keys}
+        detail[key] = {"fired": count, "evidence": seen}
+        if not any(v > 0 for v in seen.values()):
+            unattributed.append(key)
+    check = {"fired": dict(sorted(faults_fired.items())),
+             "evidence": {k: evidence[k] for k in sorted(evidence)},
+             "detail": detail}
+    if unattributed:
+        check.update(ok=False, reason=(
+            "fired faults left no observability evidence: "
+            + ", ".join(unattributed)))
+    else:
+        check["ok"] = True
+    return check
+
+
+def token_loss_accounting(outcomes: List[RequestOutcome]) -> dict:
+    """Every accepted request must end in exactly one clean state:
+    completed with its full budget (possibly via migration / evacuation
+    resume), aborted by its own client, or errored with a taxonomy string.
+    A request billed as finished short of budget — or left in no terminal
+    state at all — is silent token loss and fails the run."""
+    completed = errored = aborted = resumed = 0
+    losses: List[dict] = []
+    for o in outcomes:
+        if o.aborted:
+            aborted += 1
+            continue
+        if o.error is not None:
+            errored += 1
+            continue
+        if o.finish_reason is None:
+            losses.append({"request_id": o.request_id,
+                           "reason": "no terminal state"})
+            continue
+        if len(o.tokens) < o.osl:
+            losses.append({
+                "request_id": o.request_id,
+                "reason": (f"finished {o.finish_reason!r} with "
+                           f"{len(o.tokens)}/{o.osl} tokens"),
+            })
+            continue
+        completed += 1
+        if o.resumes or o.reconnects:
+            resumed += 1
+    check = {
+        "completed_full": completed,
+        "resumed": resumed,
+        "aborted": aborted,
+        "errored": errored,
+        "silent_losses": len(losses),
+        "losses": losses[:16],
+    }
+    if losses:
+        check.update(ok=False, reason=(
+            f"{len(losses)} request(s) silently lost tokens "
+            f"(first: {losses[0]})"))
+    else:
+        check["ok"] = True
+    return check
+
+
+def wave_recovery(
+    trace: ReplayTrace, outcomes: List[RequestOutcome],
+    window_s: Optional[float] = None,
+) -> dict:
+    """Per-chaos-wave time-to-recover: for each fault wave (and each
+    structural chaos event), the number of trace-clock windows after its
+    onset until every SLO tier is compliant again. A window is compliant
+    for a tier when no scored request arriving in it violates the tier's
+    SLOs (empty windows are compliant — nothing suffered)."""
+    duration = max(trace.duration_s, 1e-9)
+    window_s = window_s or max(duration / 12.0, 1e-3)
+    specs = {t.tier: t for t in trace.tiers()}
+
+    def _violates(o: RequestOutcome) -> bool:
+        spec = specs.get(o.tier)
+        if spec is None:
+            return False
+        mean_itl = (sum(o.itls) / len(o.itls)) if o.itls else 0.0
+        return ((o.ttft_s or 0.0) > spec.ttft_slo_s
+                or mean_itl > spec.itl_slo_s)
+
+    scored = [(o.arrival_s, o.tier, _violates(o)) for o in outcomes
+              if o.error is None and not o.aborted
+              and o.finish_reason is not None]
+    last_arrival = max((a for a, _t, _v in scored), default=0.0)
+    n_windows = int(last_arrival // window_s) + 1
+
+    waves: List[tuple] = []
+    for ev in trace.events:
+        if ev.kind == "fault":
+            waves.append((str(ev.params.get("wave", "?")), ev.at_s))
+        elif ev.kind in ("preempt", "kill_worker", "store_flap"):
+            waves.append((f"{ev.kind}@{ev.at_s}", ev.at_s))
+
+    out: Dict[str, dict] = {}
+    for name, at_s in waves:
+        k0 = int(at_s // window_s)
+        tiers: Dict[str, dict] = {}
+        worst: Optional[int] = 0
+        for tier in sorted(specs):
+            rec: Optional[int] = None
+            for k in range(k0, n_windows + 1):
+                lo, hi = k * window_s, (k + 1) * window_s
+                bad = any(v for a, t, v in scored
+                          if t == tier and lo <= a < hi)
+                if not bad:
+                    rec = k - k0
+                    break
+            tiers[str(tier)] = {"windows_to_recover": rec,
+                                "recovered": rec is not None}
+            if rec is None:
+                worst = None
+            elif worst is not None:
+                worst = max(worst, rec)
+        out[name] = {"at_s": at_s, "tiers": tiers,
+                     "windows_to_recover": worst}
+    return {"window_s": round(window_s, 6), "waves": out}
+
+
 def cross_check_ttft(
     outcomes: List[RequestOutcome], spans: List[dict],
     tol: CheckTolerances,
@@ -238,6 +419,41 @@ def cross_check_tokens(
     return check
 
 
+def _chaos_violation_rate(
+    trace: ReplayTrace, outcomes: List[RequestOutcome],
+    chaos_starts: List[float],
+) -> Optional[float]:
+    """SLO-violation rate over requests arriving at/after the first
+    scheduled chaos event — SLO-under-chaos, not SLO-under-load."""
+    if not chaos_starts:
+        return None
+    first = min(chaos_starts)
+    specs = {t.tier: t for t in trace.tiers()}
+    scored = [o for o in outcomes
+              if o.arrival_s >= first and o.error is None
+              and not o.aborted and o.finish_reason is not None]
+    if not scored:
+        return None
+    violations = 0
+    for o in scored:
+        spec = specs.get(o.tier)
+        if spec is None:
+            continue
+        mean_itl = (sum(o.itls) / len(o.itls)) if o.itls else 0.0
+        if ((o.ttft_s or 0.0) > spec.ttft_slo_s
+                or mean_itl > spec.itl_slo_s):
+            violations += 1
+    return round(violations / len(scored), 4)
+
+
+def _recovery_p99(recovery: dict) -> Optional[float]:
+    vals = [w["windows_to_recover"] for w in recovery["waves"].values()
+            if w["windows_to_recover"] is not None]
+    if not vals:
+        return None
+    return round(percentile([float(v) for v in vals], 99), 2)
+
+
 def build_scoreboard(
     trace: ReplayTrace, run: ReplayRunResult,
     tol: Optional[CheckTolerances] = None,
@@ -277,7 +493,15 @@ def build_scoreboard(
         "ttft_vs_spans": cross_check_ttft(outcomes, run.spans, tol),
         "tokens_vs_recorder": cross_check_tokens(
             outcomes, run.recorder_goodput_tokens, hit_tokens, tol),
+        "token_loss": token_loss_accounting(outcomes),
+        "fault_attribution": cross_check_fault_attribution(
+            getattr(run, "faults_fired", {}) or {},
+            getattr(run, "evidence", {}) or {}),
     }
+    recovery = wave_recovery(trace, outcomes)
+    chaos_starts = [e.at_s for e in trace.events
+                    if e.kind in ("fault", "preempt", "kill_worker",
+                                  "store_flap")]
     tier_table = _tier_table(outcomes, trace.tiers(), elapsed)
     violation_rates = [t["slo_violation_rate"] for t in tier_table.values()
                        if t["slo_violation_rate"] is not None]
@@ -311,6 +535,14 @@ def build_scoreboard(
         "events_fired": run.events_fired,
         "preempt": run.preempt,
         "num_kills": run.num_kills,
+        "faults_fired": getattr(run, "faults_fired", {}) or {},
+        "fault_log": getattr(run, "fault_log", []) or [],
+        "wave_recovery": recovery,
+        # chaos headline fields (None when the trace schedules no chaos)
+        "chaos_slo_violation_rate": _chaos_violation_rate(
+            trace, outcomes, chaos_starts),
+        "chaos_recovery_windows_p99": _recovery_p99(recovery),
+        "chaos_token_loss": checks["token_loss"]["silent_losses"],
         "chips": run.chips,
         "device_kind": run.device_kind,
         "chip_seconds": round(chip_seconds, 3),

@@ -34,6 +34,27 @@ from typing import Dict, List, Optional, Tuple
 from benchmarks.datagen import (
     PrefixDatasetConfig, generate_prefix_dataset, prefix_ground_truth,
 )
+from dynamo_tpu.runtime import faults
+
+# The fault-site vocabulary the replay event track can express. Pinned by
+# tests/test_faults_registry.py against the faults.py docstring table and
+# the faults.active() call sites — adding a seam without replay support
+# (or scheduling a fault at an unwired site) fails CI.
+FAULT_SITES = (
+    "client.connect",
+    "client.send",
+    "worker.admit",
+    "worker.stream",
+    "store.call",
+    "store.connect",
+    "store.watch",
+    "disagg.prefill",
+    "disagg.transfer",
+    "disagg.inject",
+    "preempt.notice",
+    "preempt.evacuate",
+    "engine.stall",
+)
 
 
 @dataclass
@@ -72,11 +93,33 @@ class ReplayEvent:
     """One scheduled infrastructure event. Kinds the driver understands:
     ``preempt`` (maintenance notice → evacuation on a decode worker, then
     optionally kill it), ``kill_worker`` (abrupt crash, no notice),
-    ``store_flap`` (stop the store, restart it from its snapshot)."""
+    ``store_flap`` (stop the store, restart it from its snapshot),
+    ``fault`` (install one correlated fault wave — a list of ``faults.py``
+    rule dicts tagged with the wave name; ``worker_index`` addresses
+    worker-scoped sites), ``fault_clear`` (retire one wave's rules).
+
+    Worker-scoped events carry ``worker_index``, an abstract seeded index
+    the driver maps onto the sorted worker list (``index % n_workers``) —
+    the same arithmetic in SimCluster and live-HTTP modes, so both pick
+    identical victims under the same seed."""
 
     at_s: float
     kind: str
     params: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class FaultWaveSpec:
+    """One correlated fault wave in a :class:`TraceConfig`: a named bundle
+    of fault-rule dicts installed together at ``at_frac`` of the trace
+    clock (and retired at ``clear_frac``, if set). Rule dicts use the
+    :class:`dynamo_tpu.runtime.faults.FaultRule` field names; ``site`` must
+    be in :data:`FAULT_SITES` and ``kind`` one of ``faults.KINDS``."""
+
+    name: str
+    at_frac: float
+    rules: Tuple[Dict[str, object], ...] = ()
+    clear_frac: Optional[float] = None
 
 
 @dataclass
@@ -147,6 +190,8 @@ class TraceConfig:
     kill_at_frac: Optional[float] = None
     store_flap_at_frac: Optional[float] = None
     store_flap_down_s: float = 0.2
+    # correlated fault waves (seeded faults.py schedules on the event track)
+    fault_waves: Tuple[FaultWaveSpec, ...] = ()
 
 
 def _rate(cfg: TraceConfig, t: float) -> float:
@@ -241,23 +286,55 @@ def generate_trace(cfg: TraceConfig) -> ReplayTrace:
             reconnect_after_tokens=reconnect_after,
         ))
 
+    # Event-track worker targeting: every worker-scoped event draws one
+    # abstract index from the trace RNG (drawn AFTER the request loop, so
+    # enabling events never perturbs the request stream). The driver maps
+    # ``worker_index % n_workers`` onto its sorted worker list — identical
+    # victim selection in SimCluster and live-HTTP modes.
     events: List[ReplayEvent] = []
     if cfg.preempt_at_frac is not None:
         events.append(ReplayEvent(
             at_s=round(cfg.preempt_at_frac * cfg.duration_s, 6),
             kind="preempt",
-            params={"reason": "maintenance", "kill": cfg.preempt_kill},
+            params={"reason": "maintenance", "kill": cfg.preempt_kill,
+                    "worker_index": rng.randrange(1 << 16)},
         ))
     if cfg.kill_at_frac is not None:
         events.append(ReplayEvent(
             at_s=round(cfg.kill_at_frac * cfg.duration_s, 6),
-            kind="kill_worker", params={},
+            kind="kill_worker",
+            params={"worker_index": rng.randrange(1 << 16)},
         ))
     if cfg.store_flap_at_frac is not None:
         events.append(ReplayEvent(
             at_s=round(cfg.store_flap_at_frac * cfg.duration_s, 6),
             kind="store_flap", params={"down_s": cfg.store_flap_down_s},
         ))
+    for wave in cfg.fault_waves:
+        wave_rules = []
+        for rule in wave.rules:
+            site = rule.get("site")
+            kind = rule.get("kind")
+            if site not in FAULT_SITES:
+                raise ValueError(
+                    f"fault wave {wave.name!r}: site {site!r} is not in the "
+                    f"replay site vocabulary {FAULT_SITES}")
+            if kind not in faults.KINDS:
+                raise ValueError(
+                    f"fault wave {wave.name!r}: unknown kind {kind!r} "
+                    f"(expected one of {faults.KINDS})")
+            wave_rules.append({**rule, "wave": wave.name})
+        events.append(ReplayEvent(
+            at_s=round(wave.at_frac * cfg.duration_s, 6),
+            kind="fault",
+            params={"wave": wave.name, "rules": wave_rules,
+                    "worker_index": rng.randrange(1 << 16)},
+        ))
+        if wave.clear_frac is not None:
+            events.append(ReplayEvent(
+                at_s=round(wave.clear_frac * cfg.duration_s, 6),
+                kind="fault_clear", params={"wave": wave.name},
+            ))
     events.sort(key=lambda e: e.at_s)
 
     # ground truth: dedup shared-prefix tokens summed per tenant (pools do
@@ -283,6 +360,68 @@ def generate_trace(cfg: TraceConfig) -> ReplayTrace:
         "config": json.loads(json.dumps(asdict(cfg))),
     }
     return ReplayTrace(requests=requests, events=events, meta=meta)
+
+
+# --------------------------- gauntlet scenario ---------------------------
+
+
+def gauntlet_config(seed: int) -> TraceConfig:
+    """The chaos-replay gauntlet: four correlated fault waves spanning the
+    store, relay/disagg, stall, and preemption seams, layered over bursty
+    two-tier traffic with a structural store flap and a maintenance
+    preemption. Every rule uses ``prob=1.0`` with finite ``times`` so the
+    firing counts are exhausted identically by the in-process SimCluster
+    and a live multi-process deployment under the same seed."""
+    return TraceConfig(
+        seed=seed, num_requests=40, duration_s=8.0, base_rps=9.0,
+        burst_factor=2.0, tenants=2, pools_per_tenant=2,
+        preempt_at_frac=0.62, store_flap_at_frac=0.2,
+        fault_waves=(
+            # lease keepalives are clock-gated (phase set at client spawn),
+            # so wave install kicks the op directly — exactly ``times``
+            # firings every run — and the drop pushes the victim through
+            # the full recovery path (reconnect + lease + key re-assert)
+            FaultWaveSpec(name="storewave", at_frac=0.15, rules=(
+                {"site": "store.call", "kind": "drop",
+                 "match": "lease_keepalive", "times": 2},
+            )),
+            FaultWaveSpec(name="relaywave", at_frac=0.3, rules=(
+                {"site": "worker.stream", "kind": "truncate", "times": 1},
+                {"site": "client.send", "kind": "drop", "times": 1},
+                {"site": "disagg.transfer", "kind": "truncate",
+                 "times": 1},
+            )),
+            # pinned to a pure-decode window: the watchdog deadline scales
+            # with scheduled tokens, so a prefill-heavy window could out-
+            # wait the wedge and the stall would fire but never be seen
+            FaultWaveSpec(name="stallwave", at_frac=0.45, rules=(
+                {"site": "engine.stall", "kind": "delay", "match": "decode",
+                 "delay_s": 1.5, "times": 1},
+            )),
+            FaultWaveSpec(name="preemptwave", at_frac=0.55, rules=(
+                {"site": "preempt.notice", "kind": "delay",
+                 "delay_s": 0.05, "times": 1},
+            )),
+        ),
+    )
+
+
+def generate_gauntlet_trace(seed: int) -> ReplayTrace:
+    """Generate the gauntlet trace and align the structural preemption's
+    victim with the ``preemptwave`` fault install: live-mode replays ship
+    that wave's rules to the worker addressed by the fault event, so the
+    maintenance notice must land on the same process for the
+    ``preempt.notice`` rule to fire there."""
+    trace = generate_trace(gauntlet_config(seed))
+    wave_events = {e.params.get("wave"): e for e in trace.events
+                   if e.kind == "fault"}
+    preempt_wave = wave_events.get("preemptwave")
+    if preempt_wave is not None:
+        for ev in trace.events:
+            if ev.kind == "preempt":
+                ev.params["worker_index"] = (
+                    preempt_wave.params["worker_index"])
+    return trace
 
 
 # ------------------------------ JSONL I/O -------------------------------

@@ -105,7 +105,7 @@ class WorkerMetricsPublisher:
     def __init__(
         self, component: Component, worker_id: int, stats_fn,
         interval_s: float = 1.0, extra_fn=None, spec_fn=None, obs_fn=None,
-        kvbm_fn=None, preempt_fn=None,
+        kvbm_fn=None, preempt_fn=None, faults_fn=None,
     ):
         self.component = component
         self.worker_id = worker_id
@@ -117,6 +117,10 @@ class WorkerMetricsPublisher:
         # () -> preemption dict ("preempt" key); serving assigns it after
         # start() (the coordinator is built once the endpoint is live)
         self.preempt_fn = preempt_fn
+        # () -> {"site/kind": fired} fault-plan firing counts ("faults"
+        # key) — how chaos injected into a live worker reaches the
+        # aggregator's worker_faults_fired_total gauge
+        self.faults_fn = faults_fn
         self.interval_s = interval_s
         self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
         self._task: Optional[asyncio.Task] = None
@@ -168,6 +172,13 @@ class WorkerMetricsPublisher:
                 snap["preempt"] = dict(self.preempt_fn())
             except Exception:
                 log.exception("metrics preempt_fn failed")
+        if self.faults_fn is not None:
+            try:
+                fired = self.faults_fn()
+                if fired:
+                    snap["faults"] = dict(fired)
+            except Exception:
+                log.exception("metrics faults_fn failed")
         return snap
 
     async def _pump(self) -> None:

@@ -105,7 +105,8 @@ class DistributedRuntime:
         """Start /health /live /metrics (ref: system_status_server.rs)."""
         from .system_server import SystemServer
 
-        self.system_server = SystemServer(metrics=self.metrics, port=port)
+        self.system_server = SystemServer(metrics=self.metrics, port=port,
+                                          store=self.store)
         await self.system_server.start()
 
     def _on_lease_lost(self) -> None:

@@ -735,6 +735,9 @@ class StoreClient:
         self.reconnect_cap_s: float = 5.0
         self._reconnect_rng = random.Random()
         self.num_recoveries = 0
+        # failed RPC attempts (injected faults + dead-connection calls):
+        # the store-seam evidence the replay fault-attribution check reads
+        self.num_call_errors = 0
 
     @staticmethod
     async def connect(
@@ -848,8 +851,10 @@ class StoreClient:
             faults.active("store.call", msg.get("op") or "")
         )
         if fault is not None and fault.kind in (faults.DROP, faults.REJECT):
+            self.num_call_errors += 1
             raise StoreError(f"injected store fault on {msg.get('op')!r}")
         if self._writer is None or self._writer.is_closing():
+            self.num_call_errors += 1
             raise StoreError("store client not connected")
         seq = next(self._seq)
         msg["seq"] = seq
@@ -881,6 +886,32 @@ class StoreClient:
                 log.warning("primary lease keepalive failed — recovering")
                 self._start_recovery()
                 return
+
+    async def kick_keepalive(self) -> bool:
+        """Send one primary-lease keepalive now, outside the periodic loop.
+
+        Chaos-replay hook: a ``store.call``/``lease_keepalive`` fault rule
+        gates an op the replay clock does not control — the periodic loop's
+        phase is set at client spawn, so whether a finite-``times`` rule
+        fires within a replay window depends on wall-clock luck. Kicking at
+        wave install pins each firing to a deterministic point. A failed
+        kick takes the same recovery path as a failed periodic tick.
+        """
+        try:
+            resp = await asyncio.wait_for(
+                self._call(
+                    {"op": "lease_keepalive", "lease": self.primary_lease}
+                ),
+                timeout=self._lease_ttl_s,
+            )
+            if not resp.get("ok"):
+                raise LeaseExpired("primary lease expired")
+            return True
+        except Exception:
+            if not self._closed:
+                log.warning("kicked keepalive failed — recovering")
+                self._start_recovery()
+            return False
 
     # -- reconnect / lease recovery --
 

@@ -27,10 +27,15 @@ class SystemServer:
         metrics: Optional[MetricsRegistry] = None,
         host: str = "0.0.0.0",
         port: int = 0,
+        store=None,
     ):
         self.metrics = metrics
         self.host = host
         self.port = port
+        # this process's StoreClient (when the owning runtime has one):
+        # fault-plan installs kick clock-gated rules through it so chaos
+        # replays fire deterministically (see _faults_install)
+        self.store = store
         self._probes: Dict[str, HealthProbe] = {}
         # admin drain triggers: name -> zero-arg callable kicking off a
         # graceful drain (same path as SIGINT/SIGTERM)
@@ -68,6 +73,9 @@ class SystemServer:
             web.get("/debug/profile", self._profile),
             web.get("/debug/traces", self._traces),
             web.get("/debug/traces/{trace_id}", self._trace),
+            web.get("/debug/faults", self._faults_get),
+            web.post("/debug/faults", self._faults_install),
+            web.delete("/debug/faults", self._faults_clear),
         ])
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -190,3 +198,79 @@ class SystemServer:
                 {"error": f"unknown trace id {trace_id!r}"}, status=404
             )
         return web.json_response(assemble_trace([s.to_dict() for s in spans]))
+
+    async def _faults_get(self, request: web.Request) -> web.Response:
+        """The installed fault plan (rules + firing log), for replay
+        attribution harvest and operator inspection."""
+        from . import faults
+
+        plan = faults.current()
+        if plan is None:
+            return web.json_response({"installed": False})
+        return web.json_response(
+            {"installed": True, "plan": plan.to_dict(include_log=True),
+             "fired_counts": plan.fired_counts()})
+
+    async def _faults_install(self, request: web.Request) -> web.Response:
+        """Install (or extend) the process-global fault plan from its wire
+        form. A body whose seed matches the installed plan *merges* its
+        rules in — how the replay driver lands successive correlated fault
+        waves on one process; any other seed (or no installed plan)
+        replaces the plan wholesale."""
+        from . import faults
+
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "body must be JSON"},
+                                     status=400)
+        try:
+            incoming = faults.FaultPlan.from_dict(body)
+        except (ValueError, KeyError, TypeError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        plan = faults.current()
+        merged = False
+        if plan is not None and plan.seed == incoming.seed:
+            for rule in incoming.rules:
+                plan.add(rule)
+            merged = True
+        else:
+            plan = incoming
+            faults.install(plan)
+        log.info("fault plan %s: seed=%d rules=%d",
+                 "merged" if merged else "installed", plan.seed,
+                 len(plan.rules))
+        # lease keepalives are wall-clock-periodic with a phase set at
+        # client spawn, so a finite-times rule gating them would fire a
+        # load-dependent 0..times within any replay window. Drive the op
+        # directly, once per budgeted firing, so the count is exactly
+        # ``times`` in every run (the in-process replay driver does the
+        # same — the two modes must fire identically under one seed).
+        kicked = 0
+        if self.store is not None:
+            for rule in incoming.rules:
+                if (rule.site == "store.call"
+                        and rule.match == "lease_keepalive"):
+                    for _ in range(max(1, int(rule.times or 1))):
+                        await self.store.kick_keepalive()
+                        kicked += 1
+        return web.json_response(
+            {"installed": True, "merged": merged, "seed": plan.seed,
+             "rules": len(plan.rules), "kicked": kicked})
+
+    async def _faults_clear(self, request: web.Request) -> web.Response:
+        """Clear the fault plan — or just one wave's rules with ``?wave=``
+        (the firing log survives for attribution)."""
+        from . import faults
+
+        wave = request.query.get("wave")
+        plan = faults.current()
+        if plan is None:
+            return web.json_response({"installed": False, "removed": 0})
+        if wave:
+            removed = plan.clear_wave(wave)
+            return web.json_response(
+                {"installed": True, "wave": wave, "removed": removed})
+        removed = len(plan.rules)
+        faults.clear()
+        return web.json_response({"installed": False, "removed": removed})

@@ -75,11 +75,21 @@ async def serve_engine(
         obs_gauges = EngineObsGauges(runtime.metrics, engine)
         obs_fn = obs_gauges.refresh
     kvbm = getattr(engine, "kvbm", None)
+
+    def _faults_fired() -> dict:
+        # installed via /debug/faults (chaos replay) or in-process tests;
+        # empty when no plan is active so the snapshot stays lean
+        from .runtime import faults
+
+        plan = faults.current()
+        return plan.fired_counts() if plan is not None else {}
+
     metrics_pub = WorkerMetricsPublisher(
         endpoint.component, runtime.primary_lease, lambda: engine.stats,
         spec_fn=st.to_dict if st is not None else None,
         obs_fn=obs_fn,
         kvbm_fn=kvbm.snapshot if kvbm is not None else None,
+        faults_fn=_faults_fired,
     )
     metrics_pub.start()
 

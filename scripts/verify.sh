@@ -47,6 +47,12 @@
 #                                 # cluster, cross-checked against recorder
 #                                 # + spans; echoes the repro seed
 #                                 # (DYNTPU_REPLAY_SEED=<n>) on failure
+#   scripts/verify.sh chaosreplay # chaos-replay gauntlet: seeded fault
+#                                 # waves (store flap + relay truncation +
+#                                 # stall + preemption) replayed with
+#                                 # attributed-recovery scoring; echoes the
+#                                 # repro seed (DYNTPU_REPLAY_SEED=<n>,
+#                                 # same knob as CHAOS_SEED) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -198,6 +204,24 @@ if [ "${1:-}" = "replay" ]; then
         echo "trace-replay suite FAILED; reproduce with e.g.:"
         for s in $seeds; do
             echo "  DYNTPU_${s} scripts/verify.sh replay"
+        done
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "chaosreplay" ]; then
+    set -o pipefail
+    rm -f /tmp/_chaosreplay.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaosreplay \
+        -p no:cacheprovider 2>&1 | tee /tmp/_chaosreplay.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every gauntlet run prints CHAOS_SEED (alias of REPLAY_SEED);
+        # surface a one-line repro
+        seeds=$(grep -aoE 'CHAOS_SEED=[0-9]+' /tmp/_chaosreplay.log | sed 's/CHAOS/REPLAY/' | sort -u | tr '\n' ' ')
+        echo "chaos-replay gauntlet FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh chaosreplay"
         done
     fi
     exit $rc

@@ -83,3 +83,34 @@ def test_documented_sites_exist_in_code():
         f"faults.py documents sites with no faults.active call site: "
         f"{stale}"
     )
+
+
+def test_trace_vocabulary_matches_documented_sites():
+    """The replay event track's site vocabulary (``trace.FAULT_SITES``,
+    what a trace's ``fault`` events may target) must equal the docstring
+    table exactly — a site an operator can document but not replay, or
+    replay but not read about, breaks the chaos-replay contract."""
+    from dynamo_tpu.replay.trace import FAULT_SITES
+
+    documented = _documented_sites()
+    vocab = set(FAULT_SITES)
+    assert vocab == documented, (
+        f"trace.FAULT_SITES and the faults.py docstring table disagree: "
+        f"only in FAULT_SITES: {vocab - documented}, "
+        f"only documented: {documented - vocab}"
+    )
+
+
+def test_trace_vocabulary_matches_wired_sites():
+    """And the third direction: every replayable site must be consulted
+    by a literal ``faults.active`` call somewhere in the package, and
+    every wired site must be replayable."""
+    from dynamo_tpu.replay.trace import FAULT_SITES
+
+    wired = set(_call_sites())
+    vocab = set(FAULT_SITES)
+    assert vocab == wired, (
+        f"trace.FAULT_SITES and faults.active call sites disagree: "
+        f"replayable but unwired: {vocab - wired}, "
+        f"wired but not replayable: {wired - vocab}"
+    )
