@@ -828,6 +828,158 @@ def _replay_fields(base_env: dict, timeout_s: float = 420.0) -> dict:
         return {"replay_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+async def run_prefix_bench() -> dict:
+    """Global-prefix-cache columns: one seeded shared-prefix dataset served
+    twice by a tiny CPU engine — prefix caching on vs off — reporting the
+    measured hit rate, the analytic prefill-FLOPs saved ratio, and TTFT
+    p50/p99 for both modes. Greedy outputs must match byte-for-byte across
+    the two runs, and the radix prefix index's own hit accounting must agree
+    with the scheduler's (the same invariant the replay ``prefix_vs_index``
+    cross-check enforces)."""
+    import logging
+
+    logging.getLogger("dynamo_tpu").setLevel(logging.WARNING)
+    from benchmarks.datagen import (
+        PrefixDatasetConfig, generate_prefix_dataset, prefix_ground_truth,
+    )
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import InferenceEngine, Request
+    from dynamo_tpu.observability.flops import FlopsModel
+
+    seed = int(os.environ.get("BENCH_PREFIX_SEED", 0))
+    print(f"PREFIX_SEED={seed}", flush=True)
+    isl = int(os.environ.get("BENCH_PREFIX_ISL", 512))
+    osl = int(os.environ.get("BENCH_PREFIX_OSL", 8))
+    block_size = 16
+    # high prefix_ratio with few groups: the regime the global prefix cache
+    # targets (system prompts / few-shot templates shared across requests)
+    ds = generate_prefix_dataset(PrefixDatasetConfig(
+        num_requests=int(os.environ.get("BENCH_PREFIX_REQUESTS", 24)),
+        isl=isl, prefix_ratio=0.94, groups=2, branches=2,
+        vocab_size=200, vocab_offset=10, seed=seed,
+    ))
+    gt = prefix_ground_truth(ds)
+    model_cfg = ModelConfig.tiny(vocab_size=256)
+    fm = FlopsModel(model_cfg)
+
+    def make_engine(cache_on: bool) -> InferenceEngine:
+        return InferenceEngine(
+            model_cfg,
+            EngineConfig(
+                num_blocks=512, block_size=block_size,
+                max_model_len=2 * isl, max_num_batched_tokens=isl,
+                prefill_buckets=(32, 64, 128, 256, isl),
+                decode_buckets=(4,), max_num_seqs=4,
+                enable_prefix_caching=cache_on,
+                # XLA path on CPU: pallas-interpret is a correctness tool
+                # with a flat ~300 ms/step cost that would swamp the
+                # prefill-size signal this scenario measures
+                attention_impl="einsum",
+            ),
+            seed=0,
+        )
+
+    async def run_mode(cache_on: bool) -> dict:
+        eng = make_engine(cache_on)
+        if cache_on:
+            eng.attach_prefix_cache(worker_id=0)
+        sched = eng.scheduler
+
+        async def one(i: int, r) -> tuple:
+            h0 = sched.stats.prefix_cache_hits
+            t0 = time.perf_counter()
+            ttft, toks = None, []
+            req = Request(request_id=f"px-{i}", token_ids=list(r.token_ids),
+                          max_tokens=osl, temperature=0.0, ignore_eos=True)
+            async for out in eng.submit(req):
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                toks.append(out.token_id)
+            cached = (sched.stats.prefix_cache_hits - h0) * block_size
+            return ttft, toks, cached
+
+        # warm the XLA compile caches over the full dataset (every
+        # cached-remainder prefill bucket the timed pass will hit) then
+        # clear so the timed pass starts from an empty pool
+        for i, r in enumerate(ds):
+            await one(i, r)
+        eng.clear_kv_blocks()
+
+        hits0 = sched.stats.prefix_cache_hits
+        queries0 = sched.stats.prefix_cache_queries
+        idx0 = (float(eng.prefix.index.hit_tokens_total)
+                if cache_on and eng.prefix is not None else 0.0)
+        ttfts, outputs = [], []
+        full_flops = computed_flops = 0.0
+        for i, r in enumerate(ds):
+            ttft, toks, cached = await one(i, r)
+            ttfts.append(ttft if ttft is not None else 0.0)
+            outputs.append(toks)
+            cached = min(cached, isl)
+            full_flops += fm.step_flops(isl, fm.sequence_context_sum(isl))
+            computed_flops += fm.step_flops(
+                isl - cached, fm.sequence_context_sum(isl - cached,
+                                                      start=cached))
+        hits = sched.stats.prefix_cache_hits - hits0
+        queries = sched.stats.prefix_cache_queries - queries0
+        index_tokens = None
+        if cache_on and eng.prefix is not None:
+            index_tokens = float(eng.prefix.index.hit_tokens_total) - idx0
+        await eng.stop()
+        return {
+            "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 2),
+            "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 2),
+            "hit_rate": (hits / queries if queries else 0.0),
+            "hit_tokens": hits * block_size,
+            "flops_saved_ratio": 1.0 - computed_flops / max(full_flops, 1e-9),
+            "index_hit_tokens": index_tokens,
+            "outputs": outputs,
+        }
+
+    on = await run_mode(True)
+    off = await run_mode(False)
+    speedup = off["ttft_p50_ms"] / max(on["ttft_p50_ms"], 1e-9)
+    return {
+        "prefix_seed": seed,
+        "prefix_hit_rate": round(on["hit_rate"], 4),
+        "prefill_flops_saved_ratio": round(on["flops_saved_ratio"], 4),
+        "prefix_ttft_p50_ms_cache_on": on["ttft_p50_ms"],
+        "prefix_ttft_p99_ms_cache_on": on["ttft_p99_ms"],
+        "prefix_ttft_p50_ms_cache_off": off["ttft_p50_ms"],
+        "prefix_ttft_p99_ms_cache_off": off["ttft_p99_ms"],
+        "prefix_ttft_speedup_p50": round(speedup, 2),
+        # byte-identical greedy outputs cache-on vs cache-off: the
+        # correctness bar — a hit must never change what gets generated
+        "prefix_outputs_match": on["outputs"] == off["outputs"],
+        # radix index hit accounting vs the scheduler's measured hits
+        # (same invariant as the replay prefix_vs_index cross-check)
+        "prefix_index_agree": (
+            on["index_hit_tokens"] == float(on["hit_tokens"])),
+        "prefix_hit_potential_tokens": gt["prefix_hit_potential_tokens"],
+        "prefix_total_prompt_tokens": gt["total_prompt_tokens"],
+    }
+
+
+def _prefix_fields(base_env: dict, timeout_s: float = 300.0) -> dict:
+    """Shared-prefix scenario in a CPU-pinned subprocess, same contract as
+    ``_planner_sim_fields``: failures degrade to an error note, never a
+    broken bench. BENCH_PREFIX=0 skips it entirely."""
+    if os.environ.get("BENCH_PREFIX", "1").lower() in ("0", "false", "off"):
+        return {}
+    env = dict(base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--prefix-bench"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        line = next(ln for ln in reversed(out.stdout.splitlines())
+                    if ln.startswith("{"))
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — must never break the bench
+        return {"prefix_bench_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> None:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     bench_timeout = float(os.environ.get("BENCH_TIMEOUT", 2400))
@@ -875,6 +1027,7 @@ def main() -> None:
         result["error"] = "; ".join(errors)
     result.update(_planner_sim_fields(base_env))
     result.update(_replay_fields(base_env))
+    result.update(_prefix_fields(base_env))
     print(json.dumps(result))
 
 
@@ -891,5 +1044,9 @@ if __name__ == "__main__":
         import asyncio
 
         print(json.dumps(asyncio.run(run_replay_gate())))
+    elif "--prefix-bench" in sys.argv:
+        import asyncio
+
+        print(json.dumps(asyncio.run(run_prefix_bench())))
     else:
         main()

@@ -222,6 +222,7 @@ class EngineCore(AsyncEngine):
         self._kv_epoch = itertools.count(1)
         self._kv_reservations: Dict[str, int] = {}
         self.kvbm = None  # multi-tier block manager (attach_kvbm)
+        self.prefix = None  # radix prefix cache (attach_prefix_cache)
         # run-ahead depth: how many scheduled windows may be in flight
         # before the loop waits for a landing. 1 = classic synchronous
         # schedule→execute→postprocess. The JAX engine raises this (device
@@ -289,6 +290,21 @@ class EngineCore(AsyncEngine):
     def clear_kv_blocks(self) -> None:
         """Drop the prefix cache (ref: http clear_kv_blocks endpoint)."""
         self.scheduler.pool.clear()
+
+    def attach_prefix_cache(self, config=None, worker_id: int = 0,
+                            plane=None):
+        """Enable the radix-tree prefix index on this engine. Works with
+        or without a KVBM (index-only mode still gives the scheduler-hit
+        cross-check accounting); attach AFTER ``attach_kvbm`` so tier
+        transitions (offload/G4/drop) are hooked too."""
+        from ..prefix.manager import PrefixCacheManager
+
+        self.prefix = PrefixCacheManager(
+            self, kvbm=self.kvbm, config=config, worker_id=worker_id,
+            plane=plane,
+        )
+        self.scheduler.on_prefix_match = self.prefix.on_scheduler_match
+        return self.prefix
 
     # ------------------------- submission ------------------------------
 
@@ -377,7 +393,7 @@ class EngineCore(AsyncEngine):
             seq.token_seq = TokenBlockSequence.from_tokens(
                 list(hash_ids), self.config.block_size
             )
-        if self.kvbm is not None:
+        if self.kvbm is not None or self.prefix is not None:
             # promote host-tier prefix blocks into G1 before admission so
             # the scheduler's prefix match serves them as native hits;
             # the token sequence is built once here and reused by the
@@ -389,7 +405,11 @@ class EngineCore(AsyncEngine):
                     seq.prompt_ids, self.config.block_size
                 )
             try:
-                await self.kvbm.onboard_prefix(seq.token_seq)
+                if self.prefix is not None:
+                    # peer-G1 device-plane pull, then the KVBM tier chain
+                    await self.prefix.onboard(seq.token_seq)
+                else:
+                    await self.kvbm.onboard_prefix(seq.token_seq)
             except Exception:
                 log.exception("kvbm onboard failed — prefilling from scratch")
         queue: asyncio.Queue = asyncio.Queue()
@@ -1204,6 +1224,8 @@ class EngineCore(AsyncEngine):
             del self._pending_events[:5000]
         if self.kvbm is not None:
             self.kvbm.on_pool_event(event)
+        if self.prefix is not None:
+            self.prefix.on_pool_event(event)
 
     def _flush_kv_events(self) -> None:
         if self.kv_event_sink is None:

@@ -360,6 +360,12 @@ class Scheduler:
         # chunked-prefill cap retired from the grid doesn't keep padding
         # chunks up to a stale bucket
         self.prefill_ladder = None
+        # prefix cache manager hook: called with (queried_hashes,
+        # matched_hashes) after every admission-time prefix match so the
+        # radix index keeps its own hit accounting (the replay
+        # prefix_vs_index cross-check compares the two)
+        self.on_prefix_match: Optional[
+            Callable[[List[int], List[int]], None]] = None
 
     # -- admission --
 
@@ -649,16 +655,22 @@ class Scheduler:
         # leave at least one token to compute so the step produces logits
         max_match = (seq.total_tokens - 1) // bs
         matched: List[int] = []
+        queried_hashes: List[int] = []
+        matched_hashes: List[int] = []
         for i, tb in enumerate(seq.token_seq.blocks[:max_match]):
             self.stats.prefix_cache_queries += 1
+            queried_hashes.append(tb.sequence_hash)
             bid = self.pool.lookup(tb.sequence_hash)
             if bid is None:
                 break
             self.stats.prefix_cache_hits += 1
             matched.append(bid)
+            matched_hashes.append(tb.sequence_hash)
         seq.block_table = matched
         seq.num_computed = len(matched) * bs
         seq.num_sealed_blocks = len(matched)
+        if self.on_prefix_match is not None:
+            self.on_prefix_match(queried_hashes, matched_hashes)
 
     def _ensure_slot(self, seq: SchedSeq, position: int,
                      batch: ScheduledBatch) -> bool:

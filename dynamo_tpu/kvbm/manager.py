@@ -99,6 +99,10 @@ class _Pending:
 class KvbmManager:
     """Attached to an :class:`InferenceEngine` via ``attach_kvbm``."""
 
+    # class-level default so partially-constructed fakes stay
+    # forward-compatible as attach-time collaborators are added
+    prefix = None      # radix prefix manager (prefix.manager)
+
     def __init__(self, engine, config: Optional[KvbmConfig] = None,
                  remote: Optional[StoreRemoteTier] = None):
         self.engine = engine
@@ -110,6 +114,7 @@ class KvbmManager:
         )
         self.remote = remote   # G4 tier (None = disabled)
         self.peers = None      # distributed peer-G2 plane (kvbm.distributed)
+        self.prefix = None     # radix prefix manager (prefix.manager)
         self.stats = KvbmStats()
         # seq_hash -> candidate awaiting offload; insertion-ordered
         self._pending: Dict[int, _Pending] = {}
@@ -119,7 +124,7 @@ class KvbmManager:
         """Scalar wire dict for the worker metrics publisher (the
         aggregator re-exports these as ``kvbm_*`` gauges)."""
         hs = self.host_pool.stats
-        return {
+        out = {
             "host_pool_blocks": hs.g2_blocks + hs.g3_blocks,
             "host_pool_bytes": hs.g2_bytes,
             "spills_total": hs.spills,
@@ -130,7 +135,19 @@ class KvbmManager:
             "g4_puts_total": self.stats.g4_puts,
             "g4_hits_total": self.stats.g4_hits,
             "peer_hits_total": self.stats.peer_hits,
+            # radix prefix index counters (zero while no prefix cache
+            # manager is attached — the aggregator zero-defaults them
+            # the same way for old workers on the wire)
+            "prefix_nodes": 0.0,
+            "prefix_hit_tokens_total": 0.0,
+            "prefix_evictions_total": 0.0,
         }
+        if self.prefix is not None:
+            px = self.prefix.snapshot()
+            out["prefix_nodes"] = px["prefix_nodes"]
+            out["prefix_hit_tokens_total"] = px["prefix_hit_tokens_total"]
+            out["prefix_evictions_total"] = px["prefix_evictions_total"]
+        return out
 
     # ---- pool event hook (called synchronously from the scheduler) ----
 
@@ -173,10 +190,14 @@ class KvbmManager:
             # quantized cache adds "ks"/"vs" scale tensors to the payload.
             block = {key: arr[:, i].copy() for key, arr in data.items()}
             self.host_pool.put(p.seq_hash, block)
+            if self.prefix is not None:
+                self.prefix.on_offloaded(p.seq_hash)
             if self.remote is not None:
                 try:  # write-through to the cluster-shared G4 tier
                     await self.remote.put(p.seq_hash, block)
                     self.stats.g4_puts += 1
+                    if self.prefix is not None:
+                        self.prefix.on_g4_put(p.seq_hash)
                 except Exception:
                     log.exception("G4 put failed for %x", p.seq_hash)
         if self.peers is not None:
@@ -225,6 +246,8 @@ class KvbmManager:
                     if data is not None:
                         self.stats.peer_hits += 1
                         self.host_pool.put(tb.sequence_hash, data)
+                        if self.prefix is not None:
+                            self.prefix.on_offloaded(tb.sequence_hash)
                 if data is None and self.remote is not None:
                     try:
                         data = await self.remote.get(tb.sequence_hash)
@@ -234,6 +257,8 @@ class KvbmManager:
                     if data is not None:
                         self.stats.g4_hits += 1
                         self.host_pool.put(tb.sequence_hash, data)  # promote
+                        if self.prefix is not None:
+                            self.prefix.on_offloaded(tb.sequence_hash)
                 if data is None:
                     break  # chained hashes: deeper blocks can't hit either
                 bid = pool.adopt(

@@ -144,6 +144,22 @@ class MetricsAggregator:
             "per-worker blocks onboarded from a peer worker's G2 pool",
             ["worker"]
         )
+        # global prefix cache (radix index counters ride the same "kvbm"
+        # key; zero-defaulted for workers without a prefix cache attached)
+        self._g_prefix_nodes = m.gauge(
+            "worker_prefix_nodes",
+            "per-worker radix prefix index nodes", ["worker"]
+        )
+        self._g_prefix_hit_tokens = m.gauge(
+            "worker_prefix_hit_tokens_total",
+            "per-worker prompt tokens served from the prefix cache "
+            "(index-verified)", ["worker"]
+        )
+        self._g_prefix_evictions = m.gauge(
+            "worker_prefix_evictions_total",
+            "per-worker prefix blocks evicted/demoted out of a tier",
+            ["worker"]
+        )
         # preemption tolerance ("preempt" key): maintenance notices seen
         # and where the evacuated seats went
         self._g_preempt_notices = m.gauge(
@@ -288,6 +304,12 @@ class MetricsAggregator:
             kb.get("g4_hits_total", 0.0))
         self._g_kvbm_peer_hits.labels(worker=wid).set(
             kb.get("peer_hits_total", 0.0))
+        self._g_prefix_nodes.labels(worker=wid).set(
+            kb.get("prefix_nodes", 0.0))
+        self._g_prefix_hit_tokens.labels(worker=wid).set(
+            kb.get("prefix_hit_tokens_total", 0.0))
+        self._g_prefix_evictions.labels(worker=wid).set(
+            kb.get("prefix_evictions_total", 0.0))
         pe = snap.get("preempt") or {}
         self._g_preempt_notices.labels(worker=wid).set(
             pe.get("notices", 0.0))
@@ -328,7 +350,10 @@ class MetricsAggregator:
                           self._g_dg_orphans, self._g_kvbm_bytes,
                           self._g_kvbm_spills, self._g_kvbm_onboard_reqs,
                           self._g_kvbm_g4_puts, self._g_kvbm_g4_hits,
-                          self._g_kvbm_peer_hits, self._g_preempt_notices,
+                          self._g_kvbm_peer_hits, self._g_prefix_nodes,
+                          self._g_prefix_hit_tokens,
+                          self._g_prefix_evictions,
+                          self._g_preempt_notices,
                           self._g_preempt_evacuated):
                 gauge.remove(worker=wid)
             for site, kind in self._fault_labels.pop(wid, set()):

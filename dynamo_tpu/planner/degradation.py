@@ -4,11 +4,17 @@ shed, then cheapen, then scale).
 
 The ladder is an ordered list of reversible steps:
 
-    1. ``shed_low_tier``     — admission sheds requests below ``shed_tier``
+    1. ``evict_to_host``     — demote LRU subtrees of sealed, idle prefix
+                               blocks from G1 HBM to the KVBM host pool
+                               (prefix.manager ``evict_to_host``): frees
+                               device pages for running work while keeping
+                               the prefixes onboardable, so it engages
+                               BEFORE any request is turned away
+    2. ``shed_low_tier``     — admission sheds requests below ``shed_tier``
                                (PR-1 admission controller, tier-aware)
-    2. ``clamp_spec_k``      — cap speculative draft length (verify windows
+    3. ``clamp_spec_k``      — cap speculative draft length (verify windows
                                stop amplifying decode latency under load)
-    3. ``tighten_chunking``  — cap ``prefill_chunk_tokens`` so long prompts
+    4. ``tighten_chunking``  — cap ``prefill_chunk_tokens`` so long prompts
                                stop stalling running decodes
 
 Pressure is the worst SLO overshoot ratio observed in the last window
@@ -34,7 +40,9 @@ from ..utils.logging import get_logger
 log = get_logger("planner.degradation")
 
 # engagement order; released strictly in reverse
-STEPS: Tuple[str, ...] = ("shed_low_tier", "clamp_spec_k", "tighten_chunking")
+STEPS: Tuple[str, ...] = (
+    "evict_to_host", "shed_low_tier", "clamp_spec_k", "tighten_chunking",
+)
 
 
 @dataclass
@@ -44,6 +52,9 @@ class DegradationConfig:
     shed_tier: int = 1            # min admitted tier while shed_low_tier holds
     spec_k_clamp: int = 1         # spec_k ceiling while clamp_spec_k holds
     chunk_clamp_tokens: int = 256  # prefill_chunk_tokens ceiling while held
+    # G1 blocks each worker demotes to its host pool per window while the
+    # evict_to_host rung holds
+    evict_to_host_blocks: int = 64
 
 
 class DegradationLadder:
@@ -92,6 +103,8 @@ class DegradationLadder:
         return {
             "level": self.level,
             "steps": list(engaged),
+            "evict_to_host": (cfg.evict_to_host_blocks
+                              if "evict_to_host" in engaged else 0),
             "min_tier": cfg.shed_tier if "shed_low_tier" in engaged else 0,
             "spec_k_max": (cfg.spec_k_clamp
                            if "clamp_spec_k" in engaged else None),
@@ -102,7 +115,7 @@ class DegradationLadder:
 
 
 NO_DEGRADATION = {
-    "level": 0, "steps": [], "min_tier": 0,
+    "level": 0, "steps": [], "evict_to_host": 0, "min_tier": 0,
     "spec_k_max": None, "prefill_chunk_tokens_max": None,
 }
 

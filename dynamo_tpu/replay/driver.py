@@ -143,6 +143,11 @@ class ReplayRunResult:
     faults_fired: Dict[str, int] = field(default_factory=dict)
     fault_log: List[dict] = field(default_factory=list)
     evidence: Dict[str, float] = field(default_factory=dict)
+    # the radix prefix index's OWN hit accounting (event-fed, independent
+    # of the scheduler counters above) — prefix_vs_index fails the run
+    # when the two disagree
+    prefix_index_hit_tokens: float = 0.0
+    prefix_index_queries: float = 0.0
 
 
 async def _drive_one(
@@ -332,6 +337,12 @@ async def _cluster_replay(
     def _engine_of(wid: int) -> InferenceEngine:
         return cluster._workers[wid].engine
 
+    # index-only prefix caches (no KVBM in this deployment): the radix
+    # index mirrors each pool from its event stream and keeps its own
+    # hit accounting — the independent side of the prefix_vs_index check
+    for wid in cluster.workers(cluster.decode_component):
+        _engine_of(wid).attach_prefix_cache(worker_id=wid)
+
     # warm every engine once (first compile + recorder warmup), then zero
     # the lifetime totals so they count replay work only, and baseline the
     # prefix-cache counters (warmup adds queries)
@@ -345,13 +356,18 @@ async def _cluster_replay(
             pass
         eng.mark_obs_warmup_done()
     prefix_base: Dict[int, Tuple[int, int]] = {}
+    index_base: Dict[int, Tuple[int, int]] = {}
     for wid in cluster.workers(cluster.decode_component):
-        st = _engine_of(wid).scheduler.stats
+        eng = _engine_of(wid)
+        st = eng.scheduler.stats
         prefix_base[wid] = (st.prefix_cache_hits, st.prefix_cache_queries)
+        px = eng.prefix.index
+        index_base[wid] = (px.hit_tokens_total, px.queries_total)
     mem.clear()
 
     # retired-worker accumulators: totals harvested just before a kill
     retired = {"goodput": 0.0, "steps": 0.0, "hits": 0, "queries": 0,
+               "index_hit_tokens": 0, "index_queries": 0,
                "stalls": 0.0, "store_recoveries": 0.0,
                "store_call_errors": 0.0}
     preempt_counts = {"notices": 0, "evacuated_peer": 0, "spilled": 0,
@@ -368,6 +384,11 @@ async def _cluster_replay(
         base = prefix_base.pop(wid, (0, 0))
         retired["hits"] += st.prefix_cache_hits - base[0]
         retired["queries"] += st.prefix_cache_queries - base[1]
+        px = getattr(eng, "prefix", None)
+        if px is not None:
+            ib = index_base.pop(wid, (0, 0))
+            retired["index_hit_tokens"] += px.index.hit_tokens_total - ib[0]
+            retired["index_queries"] += px.index.queries_total - ib[1]
         rt = cluster._workers[wid].runtime
         retired["store_recoveries"] += float(rt.store.num_recoveries)
         retired["store_call_errors"] += float(
@@ -498,6 +519,8 @@ async def _cluster_replay(
     goodput = retired["goodput"]
     steps = retired["steps"]
     hits, queries = retired["hits"], retired["queries"]
+    index_hit_tokens = retired["index_hit_tokens"]
+    index_queries = retired["index_queries"]
     stalls = retired["stalls"]
     store_recoveries = retired["store_recoveries"]
     store_call_errors = retired["store_call_errors"]
@@ -513,6 +536,11 @@ async def _cluster_replay(
         base = prefix_base.get(wid, (0, 0))
         hits += st.prefix_cache_hits - base[0]
         queries += st.prefix_cache_queries - base[1]
+        px = getattr(eng, "prefix", None)
+        if px is not None:
+            ib = index_base.get(wid, (0, 0))
+            index_hit_tokens += px.index.hit_tokens_total - ib[0]
+            index_queries += px.index.queries_total - ib[1]
         rt = cluster._workers[wid].runtime
         store_recoveries += float(rt.store.num_recoveries)
         store_call_errors += float(getattr(rt.store, "num_call_errors", 0))
@@ -576,6 +604,8 @@ async def _cluster_replay(
         fault_log=[{"site": e.site, "key": e.key, "kind": e.kind,
                     "wave": e.wave} for e in plan.log],
         evidence=evidence,
+        prefix_index_hit_tokens=float(index_hit_tokens),
+        prefix_index_queries=float(index_queries),
     )
 
 

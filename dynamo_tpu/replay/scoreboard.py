@@ -29,6 +29,10 @@ non-zero CLI exit) when it disagrees beyond its declared tolerance:
   recomputed, and the prefill-sampled first token of each submission —
   (plus ``token_tol_low``) and *high* by Migration-internal replays and
   decode-ahead work of cancelled streams (bounded by ``token_tol_high``).
+- **prefix vs index**: the scheduler's measured prefix-hit tokens must
+  agree exactly (``prefix_index_slack_tokens``) with the radix prefix
+  index's own event-fed hit accounting — disagreement means the global
+  prefix cache's index drifted from the block pool and fails the run.
 
 Robustness verdicts (the chaos-replay gauntlet):
 
@@ -76,6 +80,11 @@ class CheckTolerances:
     # client-expected count, after crediting prefix-cache hit tokens)
     token_tol_low: float = 0.05
     token_tol_high: float = 0.75
+    # prefix_vs_index: the scheduler's measured hit tokens and the radix
+    # index's own event-fed hit accounting count the same admissions at
+    # the same site — any divergence is index drift, so the default
+    # tolerance is exact agreement
+    prefix_index_slack_tokens: float = 0.0
 
 
 def outcome_digest(outcomes: List[RequestOutcome]) -> str:
@@ -419,6 +428,40 @@ def cross_check_tokens(
     return check
 
 
+def cross_check_prefix_vs_index(
+    run: ReplayRunResult, tol: CheckTolerances,
+) -> dict:
+    """Scheduler-measured prefix-hit tokens vs the radix index's own hit
+    accounting.
+
+    The scheduler counts pool hits at admission; the prefix manager
+    reports the same matches to the radix index, which credits a block
+    ONLY if its event-fed replica of the pool also holds it in G1. The
+    two countings share a site but not state — so any disagreement means
+    the index has drifted from the pool (missed/duplicated events, stale
+    tier markings) and the run fails."""
+    measured = float(run.prefix_hits_blocks * run.block_size)
+    index = float(getattr(run, "prefix_index_hit_tokens", 0.0))
+    check = {
+        "scheduler_hit_tokens": measured,
+        "index_hit_tokens": index,
+        "scheduler_query_blocks": float(run.prefix_queries_blocks),
+        "index_query_blocks": float(
+            getattr(run, "prefix_index_queries", 0.0)),
+        "tolerance": {"slack_tokens": tol.prefix_index_slack_tokens},
+    }
+    diff = abs(measured - index)
+    if diff > tol.prefix_index_slack_tokens:
+        check.update(ok=False, reason=(
+            f"radix index credited {index:.0f} hit tokens but the "
+            f"scheduler measured {measured:.0f} (|Δ|={diff:.0f} > "
+            f"{tol.prefix_index_slack_tokens:.0f}) — prefix index has "
+            f"drifted from the block pool"))
+    else:
+        check["ok"] = True
+    return check
+
+
 def _chaos_violation_rate(
     trace: ReplayTrace, outcomes: List[RequestOutcome],
     chaos_starts: List[float],
@@ -497,6 +540,7 @@ def build_scoreboard(
         "fault_attribution": cross_check_fault_attribution(
             getattr(run, "faults_fired", {}) or {},
             getattr(run, "evidence", {}) or {}),
+        "prefix_vs_index": cross_check_prefix_vs_index(run, tol),
     }
     recovery = wave_recovery(trace, outcomes)
     chaos_starts = [e.at_s for e in trace.events

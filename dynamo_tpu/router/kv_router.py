@@ -26,6 +26,7 @@ from ..runtime.transport import (
 from ..tracing import trace_span
 from ..utils.logging import get_logger
 from ..tokens import compute_block_hashes_for_seq
+from ..prefix.radix import TIER_G1, TIER_G2, TIER_G4, RadixPrefixIndex
 from .indexer import ApproxKvIndexer, KvIndexer, RouterEvent
 from .scheduler import KvRouterConfig, PotentialLoads, Selection, select_worker
 
@@ -47,6 +48,10 @@ class KvRouter:
     router then learns prefix placement from its own decisions only.
     """
 
+    # class-level default so partially-constructed fakes stay
+    # forward-compatible as routing collaborators are added
+    prefix_index = None
+
     def __init__(
         self,
         client: Client,
@@ -63,6 +68,17 @@ class KvRouter:
         self.config = config or KvRouterConfig()
         self.indexer = KvIndexer(block_size) if use_events else None
         self.approx = None if use_events else ApproxKvIndexer(block_size)
+        # cluster replica of the radix prefix index (prefix.radix), fed by
+        # the same KV-event stream: find_best_match scores workers by
+        # longest cached prefix, tier-weighted, for prefix-bearing requests
+        self.prefix_index = (
+            RadixPrefixIndex(block_size, tier_weights={
+                TIER_G1: 1.0,
+                TIER_G2: self.config.prefix_tier_weight_g2,
+                TIER_G4: self.config.prefix_tier_weight_g4,
+            })
+            if use_events and self.config.prefix_routing else None
+        )
         self.loads = PotentialLoads(block_size)
         # per-worker circuit breakers: tripped workers are skipped during
         # selection until their half-open probe succeeds
@@ -185,6 +201,8 @@ class KvRouter:
                 log.warning("kv_events subscription lost — resetting index")
                 for w in list(self.client.instances):
                     self.indexer.clear_worker(w)
+                    if self.prefix_index is not None:
+                        self.prefix_index.drop_worker(w)
                 await stream.cancel()
                 stream = self._stream = await self._resubscribe(subject)
                 continue
@@ -192,7 +210,11 @@ class KvRouter:
                 continue
             try:
                 payload = msgpack.unpackb(event["value"], raw=False)
-                self.indexer.apply_event(RouterEvent.from_dict(payload))
+                ev = RouterEvent.from_dict(payload)
+                self.indexer.apply_event(ev)
+                if self.prefix_index is not None:
+                    self.prefix_index.apply_event(
+                        ev.worker_id, payload["event"])
                 self._maybe_snapshot()
             except Exception:
                 log.exception("bad kv event")
@@ -342,6 +364,13 @@ class KvRouter:
                 self.indexer.apply_event(RouterEvent(
                     worker_id=w, kind="stored", blocks=tuple(hashes),
                 ))
+                if self.prefix_index is not None:
+                    # parent links aren't persisted — flat inserts still
+                    # match (lookups walk the request's own hash chain)
+                    self.prefix_index.apply_event(w, {
+                        "kind": "stored",
+                        "blocks": [{"seq_hash": h} for h in hashes],
+                    })
                 loaded += len(hashes)
             self._events_at_snapshot = self.indexer.events_applied
             log.info("index warm-start: %d blocks from snapshot", loaded)
@@ -351,6 +380,8 @@ class KvRouter:
     def _on_worker_removed(self, worker_id: int) -> None:
         if self.indexer is not None:
             self.indexer.remove_worker(worker_id)
+        if self.prefix_index is not None:
+            self.prefix_index.drop_worker(worker_id)
         if self.approx is not None:
             self.approx.remove_worker(worker_id)
         self.loads.remove_worker(worker_id)
@@ -421,7 +452,19 @@ class KvRouter:
                 )
             workers = free
         hashes = compute_block_hashes_for_seq(token_ids, self.block_size)
-        if self.indexer is not None:
+        prefix_match = None
+        if self.prefix_index is not None:
+            pm = self.prefix_index.find_matches(hashes)
+            if pm.blocks >= self.config.prefix_min_blocks and pm.scores:
+                prefix_match = pm
+        if prefix_match is not None:
+            # tier-weighted longest-cached-prefix scores: a G1 run counts
+            # full blocks, a host/store-held run counts fractionally (the
+            # onboard copy it implies). Non-prefix-bearing requests (no
+            # match, or shorter than prefix_min_blocks) keep the flat
+            # block-hash-overlap scoring below.
+            overlaps = prefix_match.scores
+        elif self.indexer is not None:
             overlaps = self.indexer.find_matches(hashes).scores
         else:
             overlaps = self.approx.find_matches_for_tokens(token_ids).scores
@@ -430,6 +473,15 @@ class KvRouter:
             self.config, overlap_weight=overlap_weight,
             temperature=temperature, rng=self._rng,
         )
+        if prefix_match is not None:
+            # load accounting wants true cached-block counts on the chosen
+            # worker, not the tier-weighted score
+            sel = Selection(
+                worker_id=sel.worker_id,
+                overlap_blocks=prefix_match.worker_blocks.get(
+                    sel.worker_id, 0),
+                logit=sel.logit,
+            )
         self.breakers.begin(sel.worker_id)
         self.loads.add(request_id, sel.worker_id, len(token_ids),
                        sel.overlap_blocks)
@@ -508,6 +560,12 @@ class KvPushRouter(AsyncEngine):
             elif e.code == ERR_UNAVAILABLE:
                 healthy = False
                 self.router.breakers.record_failure(sel.worker_id)
+                if self.router.approx is not None:
+                    # the worker is gone but its lease may not have expired
+                    # yet — without this purge the TTL'd decision history
+                    # keeps steering retries of the same prefix back at the
+                    # dead worker until remove_worker fires
+                    self.router.approx.remove_worker(sel.worker_id)
             raise
         finally:
             if healthy:

@@ -86,6 +86,16 @@ class KvEventPublisher:
         for event in events:
             kind = event.get("kind")
             blocks = tuple(event.get("blocks", ()))
+            if kind == "stored":
+                # carry the prefix-node digest explicitly: the chained
+                # seq_hash IS the path digest (tokens.py), and naming it on
+                # the wire lets radix replicas key on it without assuming
+                # the pool's internal field layout
+                blocks = tuple(
+                    {**b, "digest": b["seq_hash"]}
+                    if isinstance(b, dict) and "seq_hash" in b else b
+                    for b in blocks
+                )
             if kind is None:
                 log.warning("malformed kv event (no kind): %r", event)
                 continue

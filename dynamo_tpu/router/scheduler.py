@@ -40,6 +40,16 @@ class KvRouterConfig:
     # double-booking workers (ref: kv_router.rs:65-73 prefill_events /
     # active_sequences_events subjects)
     replica_sync: bool = True
+    # prefix-aware routing: keep a cluster replica of the radix prefix
+    # index (prefix.radix) fed by the same KV events and score workers by
+    # longest cached prefix, tier-weighted — a worker holding the run in
+    # G1 HBM outranks one that must onboard it from its host pool or the
+    # G4 store. Falls back to the flat overlap counts for requests whose
+    # match is shorter than ``prefix_min_blocks``.
+    prefix_routing: bool = True
+    prefix_min_blocks: int = 1
+    prefix_tier_weight_g2: float = 0.75
+    prefix_tier_weight_g4: float = 0.5
 
 
 def softmax_sample(
@@ -150,7 +160,7 @@ class Selection:
 def select_worker(
     workers: list,
     isl_tokens: int,
-    overlaps: Dict[WorkerId, int],
+    overlaps: Dict[WorkerId, float],
     loads: PotentialLoads,
     block_size: int,
     config: KvRouterConfig,

@@ -75,6 +75,15 @@ async def serve_engine(
         obs_gauges = EngineObsGauges(runtime.metrics, engine)
         obs_fn = obs_gauges.refresh
     kvbm = getattr(engine, "kvbm", None)
+    prefix = getattr(engine, "prefix", None)
+    # prefix counters ride the "kvbm" key of the load-metrics wire; an
+    # index-only prefix cache (no KVBM attached) still publishes them
+    if kvbm is not None:
+        kvbm_fn = kvbm.snapshot
+    elif prefix is not None:
+        kvbm_fn = prefix.snapshot
+    else:
+        kvbm_fn = None
 
     def _faults_fired() -> dict:
         # installed via /debug/faults (chaos replay) or in-process tests;
@@ -88,7 +97,7 @@ async def serve_engine(
         endpoint.component, runtime.primary_lease, lambda: engine.stats,
         spec_fn=st.to_dict if st is not None else None,
         obs_fn=obs_fn,
-        kvbm_fn=kvbm.snapshot if kvbm is not None else None,
+        kvbm_fn=kvbm_fn,
         faults_fn=_faults_fired,
     )
     metrics_pub.start()
@@ -159,6 +168,14 @@ async def serve_engine(
             changed = apply_engine_clamps(eng_cfg, actions, originals)
             if changed:
                 log.info("degradation orders applied to engine: %s", changed)
+            # evict_to_host rung: demote idle G1 prefix blocks to the host
+            # pool (prefix.manager) — fires on every order change while
+            # the rung holds (each deeper engage/release re-delivers it)
+            n_evict = int(actions.get("evict_to_host") or 0)
+            px = getattr(engine, "prefix", None)
+            if n_evict > 0 and px is not None:
+                spawn_logged(px.evict_to_host(n_evict),
+                             name="prefix-evict-to-host")
 
         served.degradation_watcher = DegradationWatcher(
             runtime.store, runtime.namespace().name, _apply

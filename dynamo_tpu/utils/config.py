@@ -110,6 +110,21 @@ class RuntimeConfig:
     # EngineConfig at engine startup so a typo rejects before load
     weight_dtype: str = "bf16"
     kv_dtype: str = "bf16"
+    # -- global prefix cache (dynamo_tpu.prefix) --
+    # radix-tree prefix index over the tiered KVBM: engine-side tier
+    # tracking + onboarding/demotion policies (attach_prefix_cache)
+    prefix_enabled: bool = True
+    # routers score workers by longest cached prefix from the radix
+    # cluster replica instead of flat block-hash overlap
+    prefix_routing: bool = True
+    # matches shorter than this many leading blocks keep overlap scoring
+    prefix_min_match_blocks: int = 1
+    # G1 blocks one degradation evict_to_host application may demote
+    prefix_evict_blocks: int = 64
+    # routing score weight of host-pool / store-held prefix blocks
+    # relative to device-resident G1 (= 1.0)
+    prefix_tier_weight_g2: float = 0.75
+    prefix_tier_weight_g4: float = 0.5
     # -- SLA planner (python -m dynamo_tpu.planner) --
     # latency statistic the SLAs are enforced on: "p99" | "p50" | "avg"
     planner_sla_quantile: str = "p99"
@@ -250,6 +265,25 @@ class RuntimeConfig:
             ENV_PREFIX + "WEIGHT_DTYPE", cfg.weight_dtype
         )
         cfg.kv_dtype = env_str(ENV_PREFIX + "KV_DTYPE", cfg.kv_dtype)
+        cfg.prefix_enabled = env_flag(
+            ENV_PREFIX + "PREFIX_ENABLED", cfg.prefix_enabled
+        )
+        cfg.prefix_routing = env_flag(
+            ENV_PREFIX + "PREFIX_ROUTING", cfg.prefix_routing
+        )
+        cfg.prefix_min_match_blocks = env_int(
+            ENV_PREFIX + "PREFIX_MIN_MATCH_BLOCKS",
+            cfg.prefix_min_match_blocks,
+        )
+        cfg.prefix_evict_blocks = env_int(
+            ENV_PREFIX + "PREFIX_EVICT_BLOCKS", cfg.prefix_evict_blocks
+        )
+        cfg.prefix_tier_weight_g2 = env_float(
+            ENV_PREFIX + "PREFIX_TIER_WEIGHT_G2", cfg.prefix_tier_weight_g2
+        )
+        cfg.prefix_tier_weight_g4 = env_float(
+            ENV_PREFIX + "PREFIX_TIER_WEIGHT_G4", cfg.prefix_tier_weight_g4
+        )
         cfg.planner_sla_quantile = env_str(
             ENV_PREFIX + "PLANNER_SLA_QUANTILE", cfg.planner_sla_quantile
         )

@@ -335,6 +335,20 @@ async def run_worker(args: argparse.Namespace) -> None:
             log.info("kvbm group %s formed (%s)", args.kvbm_group,
                      args.kvbm_group_role)
 
+    if config.prefix_enabled:
+        from .prefix.manager import PrefixCacheConfig
+
+        # after attach_kvbm so the manager chains the host-pool drop hook
+        # and mirrors the G2/G4 tiers; works index-only without KVBM
+        engine.attach_prefix_cache(
+            config=PrefixCacheConfig(
+                evict_to_host_blocks=config.prefix_evict_blocks,
+                tier_weight_g2=config.prefix_tier_weight_g2,
+                tier_weight_g4=config.prefix_tier_weight_g4,
+            ),
+            worker_id=runtime.primary_lease,
+        )
+
     handler = None
     queue_worker = None
     component = args.component

@@ -53,6 +53,12 @@
 #                                 # attributed-recovery scoring; echoes the
 #                                 # repro seed (DYNTPU_REPLAY_SEED=<n>,
 #                                 # same knob as CHAOS_SEED) on failure
+#   scripts/verify.sh prefix      # global prefix cache suite: radix-tree
+#                                 # invariants, byte parity cache-on vs
+#                                 # cache-off, tiered demote/onboard,
+#                                 # prefix-aware routing, replay
+#                                 # prefix_vs_index; echoes the repro seed
+#                                 # (DYNTPU_PREFIX_SEED=<n>) on failure
 set -u
 
 cd "$(dirname "$0")/.."
@@ -204,6 +210,23 @@ if [ "${1:-}" = "replay" ]; then
         echo "trace-replay suite FAILED; reproduce with e.g.:"
         for s in $seeds; do
             echo "  DYNTPU_${s} scripts/verify.sh replay"
+        done
+    fi
+    exit $rc
+fi
+
+if [ "${1:-}" = "prefix" ]; then
+    set -o pipefail
+    rm -f /tmp/_prefix.log
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m prefix \
+        -p no:cacheprovider 2>&1 | tee /tmp/_prefix.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        # every seeded prefix test prints its seed; surface a one-line repro
+        seeds=$(grep -aoE 'PREFIX_SEED=[0-9]+' /tmp/_prefix.log | sort -u | tr '\n' ' ')
+        echo "prefix cache suite FAILED; reproduce with e.g.:"
+        for s in $seeds; do
+            echo "  DYNTPU_${s} scripts/verify.sh prefix"
         done
     fi
     exit $rc

@@ -318,13 +318,17 @@ async def test_make_adjustments_publishes_preemption_event():
 
 def test_degradation_ladder_engages_and_releases_in_order():
     ladder = DegradationLadder(DegradationConfig())
-    assert ladder.update(2.0) == ("engage", "shed_low_tier")
+    # evict_to_host engages first: demoting idle prefix blocks to the host
+    # pool is cheaper than turning any request away
+    assert ladder.update(2.0) == ("engage", "evict_to_host")
     assert ladder.update(1.2) is None  # hysteresis band: hold
+    assert ladder.update(2.0) == ("engage", "shed_low_tier")
     assert ladder.update(2.0) == ("engage", "clamp_spec_k")
     assert ladder.update(2.0) == ("engage", "tighten_chunking")
     assert ladder.update(3.0) is None  # ladder exhausted
-    assert ladder.level == 3 and ladder.engaged == STEPS
+    assert ladder.level == 4 and ladder.engaged == STEPS
     acts = ladder.actions()
+    assert acts["evict_to_host"] == 64
     assert acts["min_tier"] == 1
     assert acts["spec_k_max"] == 1
     assert acts["prefill_chunk_tokens_max"] == 256
@@ -332,6 +336,7 @@ def test_degradation_ladder_engages_and_releases_in_order():
     assert ladder.update(0.5) == ("release", "tighten_chunking")
     assert ladder.update(0.5) == ("release", "clamp_spec_k")
     assert ladder.update(0.5) == ("release", "shed_low_tier")
+    assert ladder.update(0.5) == ("release", "evict_to_host")
     assert ladder.update(0.5) is None
     assert ladder.level == 0
     assert ladder.actions() == dict(NO_DEGRADATION)

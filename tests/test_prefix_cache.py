@@ -1,0 +1,494 @@
+"""Global prefix cache end-to-end: byte-identical greedy outputs cache-on
+vs cache-off (plain, chunked prefill, spec decode, and across a mid-run
+evict-to-host evacuation), tiered demote/onboard round trips (host pool,
+G4 store tier at bf16 and int8, device-plane peer pull), prefix-aware
+routing, the aggregator's forward-compat prefix gauges, and the replay
+scoreboard's ``prefix_vs_index`` drift check.
+
+Seeded tests print ``PREFIX_SEED=<n>`` so a failing run reproduces with
+``DYNTPU_PREFIX_SEED=<n> scripts/verify.sh prefix``.
+
+The heavy engine-spinning parity cases are additionally marked ``slow``
+so the tier-1 quick gate keeps one representative end-to-end test; the
+full depth runs under ``scripts/verify.sh prefix`` (selects ``-m
+prefix``, slow included).
+"""
+
+import asyncio
+import os
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import InferenceEngine, Request
+from dynamo_tpu.kvbm.manager import KvbmConfig
+from dynamo_tpu.prefix.radix import (
+    TIER_G1, TIER_G2, TIER_G4, RadixPrefixIndex,
+)
+from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer
+from dynamo_tpu.tokens import compute_block_hashes_for_seq
+
+pytestmark = [pytest.mark.prefix, pytest.mark.anyio]
+
+PREFIX_SEED = int(os.environ.get("DYNTPU_PREFIX_SEED", "7"))
+BS = 4
+
+
+def make_engine(cache=True, prefix=True, seed=0, worker_id=0, plane=None,
+                **over):
+    cfg = dict(num_blocks=64, block_size=BS, max_model_len=128,
+               max_num_batched_tokens=128, prefill_buckets=(128,),
+               decode_buckets=(4,), max_num_seqs=4,
+               enable_prefix_caching=cache)
+    cfg.update(over)
+    eng = InferenceEngine(ModelConfig.tiny(vocab_size=256),
+                          EngineConfig(**cfg), seed=seed)
+    if prefix:
+        eng.attach_prefix_cache(worker_id=worker_id, plane=plane)
+    return eng
+
+
+async def run_req(engine, prompt, n=4, rid="r"):
+    req = Request(request_id=rid, token_ids=list(prompt), max_tokens=n,
+                  temperature=0.0, ignore_eos=True)
+    return [o.token_id async for o in engine.submit(req)]
+
+
+def shared_prompts(seed, n=4, shared=16, tail=6):
+    """n prompts sharing a `shared`-token head with unique tails."""
+    rng = random.Random(seed)
+    base = [rng.randrange(1, 200) for _ in range(shared)]
+    return [base + [rng.randrange(1, 200) for _ in range(tail)]
+            for _ in range(n)]
+
+
+# ---------------------- byte-identical outputs -------------------------
+
+
+async def test_byte_identical_cache_on_vs_off():
+    """Greedy outputs must not depend on whether the prefix cache served
+    any block — and the radix index's independent hit accounting must
+    agree exactly with the scheduler's measured hits."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+    ps = shared_prompts(PREFIX_SEED)
+    on = make_engine(cache=True)
+    off = make_engine(cache=False, prefix=False)
+    for i, p in enumerate(ps):
+        got_on = await run_req(on, p, rid=f"on{i}")
+        got_off = await run_req(off, p, rid=f"off{i}")
+        assert got_on == got_off, f"prompt {i} diverged under caching"
+    assert on.scheduler.stats.prefix_cache_hits > 0
+    # the prefix_vs_index invariant, in-process
+    assert (on.prefix.index.hit_tokens_total
+            == on.scheduler.stats.prefix_cache_hits * BS)
+    assert (on.prefix.index.queries_total
+            == on.scheduler.stats.prefix_cache_queries)
+    await on.stop()
+    await off.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("over", [
+    {"prefill_chunk_tokens": 8},
+    {"spec_mode": "ngram", "spec_k": 3},
+], ids=["chunked-prefill", "spec-decode"])
+async def test_byte_identical_under_modes(over):
+    """Prefix hits compose with chunked prefill and speculative decode
+    without perturbing greedy outputs."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+    ps = shared_prompts(PREFIX_SEED + 1)
+    on = make_engine(cache=True, **over)
+    off = make_engine(cache=False, prefix=False, **over)
+    for i, p in enumerate(ps):
+        assert (await run_req(on, p, rid=f"on{i}")
+                == await run_req(off, p, rid=f"off{i}"))
+    assert on.scheduler.stats.prefix_cache_hits > 0
+    await on.stop()
+    await off.stop()
+
+
+@pytest.mark.slow
+async def test_mid_run_evacuation_byte_parity():
+    """Demoting G1 prefixes to the host pool mid-run (the degradation
+    ladder's evict_to_host rung) then re-onboarding them must stay
+    byte-identical to an uncached run."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+    prompt = shared_prompts(PREFIX_SEED + 2, n=1, shared=24, tail=4)[0]
+    eng = make_engine(cache=True, prefix=False)
+    eng.attach_kvbm(KvbmConfig(host_blocks=64))
+    eng.attach_prefix_cache(worker_id=0)
+    ref = make_engine(cache=False, prefix=False)
+
+    got0 = await run_req(eng, prompt, rid="a0")
+    # demote once the request's blocks are released (sealed + evictable)
+    demoted = 0
+    for _ in range(100):
+        demoted = await eng.prefix.evict_to_host(64)
+        if demoted:
+            break
+        await asyncio.sleep(0.02)
+    assert demoted > 0
+    assert eng.prefix.demoted_blocks == demoted
+    assert eng.prefix.index.tier_blocks(TIER_G2, 0) >= demoted
+    onboarded0 = eng.kvbm.stats.onboarded_blocks
+
+    got1 = await run_req(eng, prompt, rid="a1")
+    ref0 = await run_req(ref, prompt, rid="r0")
+    assert got0 == ref0
+    assert got1 == ref0, "post-evacuation rerun diverged"
+    assert eng.kvbm.stats.onboarded_blocks > onboarded0, \
+        "rerun never onboarded the demoted prefix"
+    await eng.stop()
+    await ref.stop()
+
+
+# ----------------------- G4 onboard byte parity ------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+async def test_g4_onboard_byte_parity(kv_dtype):
+    """A prefix onboarded from the G4 store tier must be byte-identical
+    to recomputing it — per cache array, at bf16 and with the quantized
+    int8 KV payloads."""
+    from dynamo_tpu.kvbm.manager import StoreRemoteTier
+    from dynamo_tpu.runtime.store import StoreClient, StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    client = await StoreClient.connect(f"127.0.0.1:{server.port}")
+    try:
+        remote = StoreRemoteTier(client, namespace=f"px-{kv_dtype}")
+        prompt = list(range(1, 41))                 # 10 complete blocks
+        hashes = compute_block_hashes_for_seq(prompt, BS)
+
+        e1 = make_engine(cache=True, prefix=False, kv_dtype=kv_dtype)
+        e1.attach_kvbm(KvbmConfig(host_blocks=64), remote=remote)
+        e1.attach_prefix_cache(worker_id=1)
+        first = await run_req(e1, prompt, rid="w")
+        for _ in range(100):
+            if e1.kvbm.stats.g4_puts >= len(hashes):
+                break
+            await asyncio.sleep(0.05)
+        assert e1.kvbm.stats.g4_puts >= len(hashes)
+        # write-through marks the G4 tier in the index
+        assert e1.prefix.index.tier_blocks(TIER_G4, 1) >= len(hashes)
+        await e1.stop()
+
+        # fresh engine, same weights, empty local tiers → G4 onboard
+        e2 = make_engine(cache=True, prefix=False, kv_dtype=kv_dtype)
+        e2.attach_kvbm(KvbmConfig(host_blocks=64), remote=remote)
+        e2.attach_prefix_cache(worker_id=2)
+        again = await run_req(e2, prompt, rid="c")
+        assert e2.kvbm.stats.g4_hits > 0
+        assert again == first
+
+        # recompute the same prompt cold and compare the cache payloads
+        # block-for-block (quantized payloads + scales included)
+        e3 = make_engine(cache=False, prefix=False, kv_dtype=kv_dtype)
+        await run_req(e3, prompt, rid="ref")
+        bids2 = [e2.scheduler.pool._cached[h] for h in hashes]
+        bids3 = [e3.scheduler.pool._cached[h] for h in hashes]
+        d2 = await e2.extract_kv_blocks(bids2)
+        d3 = await e3.extract_kv_blocks(bids3)
+        assert set(d2) == set(d3)
+        for key in sorted(d3):
+            np.testing.assert_array_equal(
+                np.asarray(d2[key]), np.asarray(d3[key]),
+                err_msg=f"{kv_dtype} cache array {key!r} not byte-equal")
+        await e2.stop()
+        await e3.stop()
+    finally:
+        await client.close()
+        await server.stop()
+
+
+# ----------------------- device-plane onboarding -----------------------
+
+
+@pytest.mark.slow
+async def test_ici_peer_onboard_byte_parity():
+    """A prompt whose prefix lives only in a PEER worker's G1 is pulled
+    over the device plane instead of recomputed — token-exact."""
+    from dynamo_tpu.disagg.ici import DevicePlane
+
+    plane = DevicePlane()
+    a = make_engine(prefix=False)
+    b = make_engine(prefix=False)
+    a.attach_prefix_cache(worker_id=1, plane=plane)
+    b.attach_prefix_cache(worker_id=2, plane=plane)
+    plane.register("pa", a)
+    plane.register("pb", b)
+    b.prefix.peer_planes[1] = "pa"
+
+    prompt = list(range(1, 33))                     # 8 complete blocks
+    got_a = await run_req(a, prompt, rid="warm")
+
+    # B learns A's G1 state from A's router-event stream (synthesized
+    # here from the hash chain, as the publisher would emit it)
+    hashes = compute_block_hashes_for_seq(prompt, BS)
+    blocks, parent = [], None
+    for h in hashes:
+        blocks.append({"digest": h, "block_hash": h, "parent": parent})
+        parent = h
+    b.prefix.ingest_router_event(1, {"kind": "stored", "blocks": blocks})
+
+    got_b = await run_req(b, prompt, rid="cold")
+    assert b.prefix.ici_onboarded_blocks >= len(hashes)
+    assert got_b == got_a
+
+    ref = make_engine(cache=False, prefix=False)
+    assert got_b == await run_req(ref, prompt, rid="ref")
+    await a.stop()
+    await b.stop()
+    await ref.stop()
+
+
+# -------------------------- routing units ------------------------------
+
+
+def _fake_router(prefix_index=None, indexer=None, approx=None):
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.router.scheduler import KvRouterConfig, PotentialLoads
+    from dynamo_tpu.runtime.circuit import CircuitBreakerRegistry
+
+    class FakeClient:
+        class endpoint:
+            path = "t/backend/generate"
+        on_instance_removed = []
+
+        def instance_ids(self):
+            return [1, 2]
+
+    router = KvRouter.__new__(KvRouter)
+    router.client = FakeClient()
+    router.component = None
+    router.block_size = BS
+    router.config = KvRouterConfig(replica_sync=False)
+    router.indexer = indexer
+    router.approx = approx
+    router.prefix_index = prefix_index
+    router.loads = PotentialLoads(BS)
+    router.worker_stats = {}
+    router.breakers = CircuitBreakerRegistry()
+    router.draining = set()
+    router._rng = random.Random(0)
+    return router
+
+
+def test_prefix_aware_routing_prefers_g1_over_g4():
+    """Tier-weighted longest-cached-prefix scoring: a worker holding the
+    run in G1 outranks one holding the same run only in G4, and the
+    selection reports true cached-block counts for load accounting."""
+    idx = RadixPrefixIndex(BS)
+    toks = list(range(1, 17))                       # 4 blocks
+    parent = None
+    for h in compute_block_hashes_for_seq(toks, BS):
+        idx.insert(h, h, parent, TIER_G1, 1)
+        idx.insert(h, h, parent, TIER_G4, 2)
+        parent = h
+    router = _fake_router(prefix_index=idx, indexer=KvIndexer(BS))
+    sel = router.find_best_match("q1", toks)
+    assert sel.worker_id == 1
+    assert sel.overlap_blocks == 4                  # blocks, not weights
+    router.free("q1")
+
+
+def test_prefix_routing_falls_back_to_flat_indexer():
+    """Below prefix_min_blocks (or with no radix match) the flat
+    block-hash overlap scoring still routes."""
+    toks = list(range(1, 17))
+    hashes = compute_block_hashes_for_seq(toks, BS)
+    flat = KvIndexer(BS)
+    from dynamo_tpu.router.indexer import RouterEvent
+    flat.apply_event(RouterEvent(
+        worker_id=2, kind="stored",
+        blocks=tuple({"seq_hash": h} for h in hashes)))
+    router = _fake_router(prefix_index=RadixPrefixIndex(BS), indexer=flat)
+    sel = router.find_best_match("q2", toks)
+    assert sel.worker_id == 2
+    assert sel.overlap_blocks == 4
+    router.free("q2")
+
+
+def test_approx_remove_worker_purges_history():
+    """Regression: removing a worker must purge its TTL'd routing-decision
+    history, or retries keep steering the same prefix at a dead worker."""
+    approx = ApproxKvIndexer(BS, ttl_s=60.0)
+    toks = list(range(12))
+    approx.record_routing_decision(5, toks)
+    approx.record_routing_decision(6, toks)
+    assert set(approx.find_matches_for_tokens(toks).scores) == {5, 6}
+    approx.remove_worker(5)
+    assert set(approx.find_matches_for_tokens(toks).scores) == {6}
+
+
+def test_worker_removed_drops_prefix_replica():
+    idx = RadixPrefixIndex(BS)
+    toks = list(range(1, 17))
+    parent = None
+    for h in compute_block_hashes_for_seq(toks, BS):
+        idx.insert(h, h, parent, TIER_G1, 1)
+        idx.insert(h, h, parent, TIER_G1, 2)
+        parent = h
+    router = _fake_router(prefix_index=idx, indexer=KvIndexer(BS),
+                          approx=ApproxKvIndexer(BS))
+    router._on_worker_removed(1)
+    assert idx.tier_blocks(TIER_G1, 1) == 0
+    assert idx.tier_blocks(TIER_G1, 2) == 4
+    idx.check_invariants()
+
+
+async def test_unavailable_stream_purges_approx_history():
+    """An ERR_UNAVAILABLE mid-stream purges the dead worker's approx
+    history so the retry does not route straight back at it."""
+    from dynamo_tpu.router.kv_router import KvPushRouter
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.transport import EngineError, ERR_UNAVAILABLE
+
+    approx = ApproxKvIndexer(BS, ttl_s=60.0)
+    router = _fake_router(approx=approx)
+
+    class DeadClient(type(router.client)):
+        async def direct(self, worker_id, request, context):
+            raise EngineError("lease gone", ERR_UNAVAILABLE)
+            yield  # pragma: no cover — makes this an async generator
+
+    router.client = DeadClient()
+    push = KvPushRouter(router)
+    toks = list(range(12))
+    with pytest.raises(EngineError):
+        async for _ in push.generate({"token_ids": toks},
+                                     Context(request_id="q3")):
+            pass
+    # find_best_match recorded the decision; the failure must erase it
+    assert approx.find_matches_for_tokens(toks).scores == {}
+
+
+# ------------------------ aggregator gauges ----------------------------
+
+
+async def test_aggregator_prefix_gauges_forward_compat_and_expiry():
+    """The three prefix gauges zero-default for workers whose snapshot
+    predates the prefix cache, and expire with the worker."""
+    import msgpack
+
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import StoreServer
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    server = StoreServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        runtime = await DistributedRuntime.from_settings(RuntimeConfig(
+            store_addr=f"127.0.0.1:{server.port}"
+        ))
+        now = [0.0]
+        agg = MetricsAggregator(runtime, "backend", stale_after_s=5.0,
+                                clock=lambda: now[0])
+        await agg.start()
+        subject = runtime.namespace().component("backend").event_subject(
+            "load_metrics"
+        )
+        # worker 1: pre-prefix-cache snapshot — kvbm block, no prefix keys
+        await runtime.store.publish(subject + "1", msgpack.packb({
+            "worker_id": 1, "kv_usage": 0.1, "num_requests_running": 0,
+            "num_requests_waiting": 0,
+            "kvbm": {"host_pool_bytes": 64.0},
+        }))
+        # worker 2: prefix counters riding the kvbm wire key
+        await runtime.store.publish(subject + "2", msgpack.packb({
+            "worker_id": 2, "kv_usage": 0.2, "num_requests_running": 1,
+            "num_requests_waiting": 0,
+            "kvbm": {"prefix_nodes": 12.0,
+                     "prefix_hit_tokens_total": 480.0,
+                     "prefix_evictions_total": 3.0},
+        }))
+        for _ in range(100):
+            if {"1", "2"} <= set(agg.worker_stats):
+                break
+            await asyncio.sleep(0.01)
+        body = runtime.metrics.render().decode()
+        c = 'component="backend"'
+        assert f'worker_prefix_nodes{{{c},worker="2"}} 12' in body
+        assert f'worker_prefix_hit_tokens_total{{{c},worker="2"}} 480' \
+            in body
+        assert f'worker_prefix_evictions_total{{{c},worker="2"}} 3' in body
+        # the prefix-less worker zero-defaults instead of going unreported
+        assert f'worker_prefix_nodes{{{c},worker="1"}} 0' in body
+        assert f'worker_prefix_hit_tokens_total{{{c},worker="1"}} 0' \
+            in body
+
+        now[0] = 10.0  # silent past stale_after_s
+        agg.expire_stale()
+        body = runtime.metrics.render().decode()
+        assert 'worker="2"' not in body
+        await agg.stop()
+        await runtime.shutdown()
+    finally:
+        await server.stop()
+
+
+# ---------------------- replay cross-check teeth -----------------------
+
+
+def _run_like(hits_blocks=5, queries_blocks=10, index_tokens=20.0,
+              index_queries=10.0):
+    return SimpleNamespace(
+        prefix_hits_blocks=hits_blocks, prefix_queries_blocks=queries_blocks,
+        block_size=BS, prefix_index_hit_tokens=index_tokens,
+        prefix_index_queries=index_queries,
+    )
+
+
+def test_prefix_vs_index_check_passes_on_agreement():
+    from dynamo_tpu.replay.scoreboard import (
+        CheckTolerances, cross_check_prefix_vs_index,
+    )
+
+    chk = cross_check_prefix_vs_index(_run_like(), CheckTolerances())
+    assert chk["ok"]
+    assert chk["scheduler_hit_tokens"] == 20.0
+    assert chk["index_hit_tokens"] == 20.0
+
+
+def test_prefix_vs_index_check_fails_on_drift():
+    """Any disagreement between the scheduler's measured hits and the
+    radix index's own accounting fails the run — zero tolerance."""
+    from dynamo_tpu.replay.scoreboard import (
+        CheckTolerances, cross_check_prefix_vs_index,
+    )
+
+    chk = cross_check_prefix_vs_index(
+        _run_like(index_tokens=16.0), CheckTolerances())
+    assert not chk["ok"]
+    assert "drifted" in chk["reason"]
+    # over-crediting is just as much a drift as under-crediting
+    chk = cross_check_prefix_vs_index(
+        _run_like(index_tokens=24.0), CheckTolerances())
+    assert not chk["ok"]
+
+
+# --------------------------- config knobs ------------------------------
+
+
+def test_runtime_config_prefix_env_knobs(monkeypatch):
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    monkeypatch.setenv("DYNTPU_PREFIX_ENABLED", "0")
+    monkeypatch.setenv("DYNTPU_PREFIX_ROUTING", "0")
+    monkeypatch.setenv("DYNTPU_PREFIX_MIN_MATCH_BLOCKS", "3")
+    monkeypatch.setenv("DYNTPU_PREFIX_EVICT_BLOCKS", "128")
+    monkeypatch.setenv("DYNTPU_PREFIX_TIER_WEIGHT_G2", "0.5")
+    monkeypatch.setenv("DYNTPU_PREFIX_TIER_WEIGHT_G4", "0.25")
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.prefix_enabled is False
+    assert cfg.prefix_routing is False
+    assert cfg.prefix_min_match_blocks == 3
+    assert cfg.prefix_evict_blocks == 128
+    assert cfg.prefix_tier_weight_g2 == pytest.approx(0.5)
+    assert cfg.prefix_tier_weight_g4 == pytest.approx(0.25)

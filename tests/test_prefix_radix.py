@@ -1,0 +1,323 @@
+"""Radix prefix index invariants: insert/split/evict determinism,
+block-aligned boundary handling (partial trailing blocks are never
+indexed), tier-state transitions under seeded churn, and the
+LRU-by-subtree eviction policy.
+
+Seeded tests print ``PREFIX_SEED=<n>`` so a failing run reproduces with
+``DYNTPU_PREFIX_SEED=<n> scripts/verify.sh prefix``.
+"""
+
+import os
+import random
+
+import pytest
+
+from dynamo_tpu.prefix.radix import (
+    DEFAULT_TIER_WEIGHTS, TIER_G1, TIER_G2, TIER_G4, TIERS,
+    RadixPrefixIndex,
+)
+from dynamo_tpu.tokens import compute_block_hashes_for_seq
+
+pytestmark = pytest.mark.prefix
+
+PREFIX_SEED = int(os.environ.get("DYNTPU_PREFIX_SEED", "7"))
+BS = 4
+
+
+def chain(tokens):
+    """Chained block hashes (complete blocks only) for a token list."""
+    return compute_block_hashes_for_seq(tokens, BS)
+
+
+def insert_chain(idx, hashes, tier=TIER_G1, worker=0):
+    parent = None
+    for h in hashes:
+        idx.insert(h, h, parent, tier, worker)
+        parent = h
+
+
+def snapshot_structure(idx):
+    """Order-independent structural fingerprint of the tree."""
+    return {
+        h: (n.parent, n.depth, tuple(sorted(n.children)),
+            tuple((t, tuple(sorted(ws)))
+                  for t, ws in sorted(n.holders.items())))
+        for h, n in idx._nodes.items()
+    }
+
+
+# ------------------------- boundary handling ---------------------------
+
+
+def test_partial_trailing_block_never_indexed():
+    """Only complete blocks get hashes, so the ragged tail of a prompt
+    can never enter the index — the block-aligned boundary invariant."""
+    toks = list(range(1, 11))           # 10 tokens, block 4 → 2 complete
+    assert len(chain(toks)) == 2
+    assert len(chain(toks[:12])) == 2   # still 2 until block 3 completes
+    assert chain(toks) == chain(toks + [99])[:2]  # tail never perturbs
+
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, chain(toks))
+    # a query for the 12-token extension matches exactly the 2 indexed
+    # blocks; the partial tail contributes nothing
+    m = idx.find_matches(chain(toks + [99, 100]))
+    assert m.blocks == 2
+    assert len(idx) == 2
+
+
+def test_chained_hash_divergence_is_a_radix_split():
+    """Two prompts sharing 2 leading blocks then diverging share exactly
+    the 2 prefix nodes; the divergent continuations hang off the shared
+    parent (implicit split, no copying)."""
+    shared = list(range(1, 9))                      # 2 blocks
+    a = chain(shared + [10, 11, 12, 13])
+    b = chain(shared + [20, 21, 22, 23])
+    assert a[:2] == b[:2] and a[2] != b[2]
+
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, a, worker=1)
+    insert_chain(idx, b, worker=2)
+    assert len(idx) == 4                            # 2 shared + 2 leaves
+    split = idx.get(a[1])
+    assert split.children == {a[2], b[2]}
+    assert idx.get(a[2]).depth == 3
+    idx.check_invariants()
+
+    # both workers hold the shared run; only one holds each leaf
+    assert idx.get(a[0]).workers() == {1, 2}
+    assert idx.get(a[2]).workers() == {1}
+    assert idx.get(b[2]).workers() == {2}
+
+
+# ------------------------ insert determinism ---------------------------
+
+
+def test_insert_order_permutations_converge():
+    """Any insertion order of the same (node, parent) set — including
+    children arriving before parents (orphan adoption) — produces the
+    identical tree."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+    rng = random.Random(PREFIX_SEED)
+    shared = [rng.randrange(1, 200) for _ in range(8)]
+    chains = [chain(shared + [rng.randrange(1, 200) for _ in range(8)])
+              for _ in range(3)]
+    ops = []
+    for ci, hs in enumerate(chains):
+        parent = None
+        for h in hs:
+            ops.append((h, parent, ci % 2))
+            parent = h
+
+    reference = None
+    for trial in range(6):
+        perm = list(ops)
+        rng.shuffle(perm)
+        idx = RadixPrefixIndex(BS)
+        for h, parent, w in perm:
+            idx.insert(h, h, parent, TIER_G1, w)
+        idx.check_invariants()
+        assert not idx._orphans, "all parents present — no orphans remain"
+        structure = snapshot_structure(idx)
+        if reference is None:
+            reference = structure
+        else:
+            assert structure == reference, f"permutation {trial} diverged"
+
+
+def test_orphan_child_reattaches_when_parent_arrives():
+    hs = chain(list(range(1, 13)))                  # 3 blocks
+    idx = RadixPrefixIndex(BS)
+    idx.insert(hs[2], hs[2], hs[1], TIER_G1, 0)     # grandchild first
+    idx.insert(hs[1], hs[1], hs[0], TIER_G1, 0)
+    assert idx.get(hs[2]).seq_hash in idx.get(hs[1]).children
+    idx.insert(hs[0], hs[0], None, TIER_G1, 0)
+    idx.check_invariants()
+    assert idx._roots == {hs[0]}
+    # the full chain now matches end to end
+    assert idx.find_matches(hs).blocks == 3
+
+
+# ----------------------- tier transitions ------------------------------
+
+
+def test_tier_marks_and_weighted_scores():
+    hs = chain(list(range(1, 9)))                   # 2 blocks
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, hs, tier=TIER_G1, worker=1)
+    insert_chain(idx, hs, tier=TIER_G4, worker=2)
+    m = idx.find_matches(hs)
+    assert m.blocks == 2
+    assert m.scores == {1: 2 * DEFAULT_TIER_WEIGHTS[TIER_G1],
+                        2: 2 * DEFAULT_TIER_WEIGHTS[TIER_G4]}
+    assert m.worker_blocks == {1: 2, 2: 2}
+
+    # demote worker 1's copy: G1 → G2 (mark then unmark, the manager's
+    # evict_to_host order) — the node must survive the transition
+    for h in hs:
+        assert idx.mark(h, TIER_G2, 1)
+        assert idx.unmark(h, TIER_G1, 1)
+    assert idx.tier_blocks(TIER_G1, 1) == 0
+    assert idx.tier_blocks(TIER_G2, 1) == 2
+    m = idx.find_matches(hs)
+    assert m.scores[1] == pytest.approx(2 * DEFAULT_TIER_WEIGHTS[TIER_G2])
+
+    # dropping the last holder prunes the chain entirely
+    idx.drop_worker(1)
+    idx.drop_worker(2)
+    assert len(idx) == 0
+    idx.check_invariants()
+
+
+def test_interior_node_survives_while_descendant_held():
+    hs = chain(list(range(1, 13)))                  # 3 blocks
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, hs)
+    # parent loses its holding but the child is still held → parent stays
+    # as structure (matching needs the path), child keeps depth
+    idx.unmark(hs[1], TIER_G1, 0)
+    assert hs[1] in idx
+    idx.check_invariants()
+    # once the leaf goes, the hold-free interior chain unwinds
+    idx.unmark(hs[2], TIER_G1, 0)
+    assert hs[1] not in idx and hs[2] not in idx
+    assert hs[0] in idx                             # still held
+    idx.check_invariants()
+
+
+def test_no_skip_matching_after_hole():
+    """A worker evicting a middle block must stop contributing scores at
+    the hole — prefix matching never skips."""
+    hs = chain(list(range(1, 17)))                  # 4 blocks
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, hs, worker=1)
+    insert_chain(idx, hs, worker=2)
+    idx.unmark(hs[1], TIER_G1, 1)
+    m = idx.find_matches(hs)
+    assert m.blocks == 4                            # worker 2's run intact
+    assert m.worker_blocks == {1: 1, 2: 4}
+
+
+# --------------------------- eviction ----------------------------------
+
+
+def test_lru_subtree_evicts_cold_branch_whole():
+    """Eviction takes the branch whose MOST RECENT use is oldest — a cold
+    conversation goes at once; the hot shared run survives."""
+    shared = list(range(1, 9))
+    a = chain(shared + [10, 11, 12, 13])            # branch A
+    b = chain(shared + [20, 21, 22, 23])            # branch B
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, a)
+    insert_chain(idx, b)
+    # touch branch A (a match walks it) → B is now the LRU subtree
+    idx.find_matches(a)
+    victim = idx.lru_subtree(TIER_G1)
+    assert victim == [b[2]]
+    evicted = idx.evict_lru_subtree(TIER_G1)
+    assert evicted == [b[2]]
+    assert b[2] not in idx
+    # shared run + branch A untouched
+    assert idx.find_matches(a).blocks == 3
+    assert idx.evictions_total == 1
+    idx.check_invariants()
+
+
+def test_eviction_determinism_and_tie_break():
+    """Same operation sequence ⇒ same eviction order (logical clock, ties
+    on seq_hash) — replayable under seeded churn."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+
+    def build_and_drain(seed):
+        rng = random.Random(seed)
+        idx = RadixPrefixIndex(BS)
+        for _ in range(12):
+            toks = [rng.randrange(1, 50) for _ in range(rng.choice((8, 12)))]
+            insert_chain(idx, chain(toks), worker=rng.randrange(2))
+        order = []
+        while True:
+            ev = idx.evict_lru_subtree(TIER_G1)
+            if not ev:
+                break
+            order.append(tuple(ev))
+        assert len(idx) == 0
+        return order
+
+    assert build_and_drain(PREFIX_SEED) == build_and_drain(PREFIX_SEED)
+
+
+def test_seeded_churn_preserves_invariants():
+    """Random insert/mark/unmark/evict/drop churn: structural invariants
+    and counters stay coherent at every step."""
+    print(f"PREFIX_SEED={PREFIX_SEED}")
+    rng = random.Random(PREFIX_SEED)
+    idx = RadixPrefixIndex(BS)
+    live_chains = []
+    for step in range(400):
+        op = rng.randrange(6)
+        if op <= 1 or not live_chains:
+            toks = [rng.randrange(1, 40)
+                    for _ in range(4 * rng.randrange(1, 5))]
+            hs = chain(toks)
+            insert_chain(idx, hs, tier=rng.choice(TIERS),
+                         worker=rng.randrange(3))
+            live_chains.append(hs)
+        elif op == 2:
+            hs = rng.choice(live_chains)
+            idx.mark(rng.choice(hs), rng.choice(TIERS), rng.randrange(3))
+        elif op == 3:
+            hs = rng.choice(live_chains)
+            idx.unmark(rng.choice(hs), rng.choice(TIERS), rng.randrange(3))
+        elif op == 4:
+            idx.evict_lru_subtree(rng.choice(TIERS),
+                                  worker=rng.randrange(3))
+        else:
+            idx.drop_worker(rng.randrange(3))
+        idx.check_invariants()
+    assert idx.inserted_total > 0
+    stats = idx.stats()
+    assert stats["prefix_nodes"] == float(len(idx))
+
+
+# ------------------------- hit accounting ------------------------------
+
+
+def test_record_hit_blocks_verifies_against_index():
+    """Hits are credited only for blocks the index itself holds in the
+    claimed tier — the drift detector behind ``prefix_vs_index``."""
+    hs = chain(list(range(1, 17)))                  # 4 blocks
+    idx = RadixPrefixIndex(BS)
+    insert_chain(idx, hs[:3], worker=0)             # index knows 3
+    credited = idx.record_hit_blocks(hs, TIER_G1, worker=0)
+    assert credited == 3 * BS                       # 4th claim rejected
+    assert idx.hit_tokens_total == 3 * BS
+    # wrong tier / wrong worker credit nothing
+    assert idx.record_hit_blocks(hs, TIER_G2, worker=0) == 0
+    assert idx.record_hit_blocks(hs, TIER_G1, worker=9) == 0
+
+
+# ------------------------- router event feed ---------------------------
+
+
+def test_apply_event_stored_removed_cleared():
+    hs = chain(list(range(1, 13)))
+    idx = RadixPrefixIndex(BS)
+    blocks = []
+    parent = None
+    for h in hs:
+        blocks.append({"digest": h, "seq_hash": h, "block_hash": h,
+                       "parent": parent})
+        parent = h
+    idx.apply_event(3, {"kind": "stored", "blocks": blocks})
+    assert idx.find_matches(hs).worker_blocks == {3: 3}
+    idx.check_invariants()
+    # G2 tier rides the same event shape (kvbm offload announcements)
+    idx.apply_event(4, {"kind": "stored", "tier": TIER_G2, "blocks": [
+        {**b, "tier": TIER_G2} for b in blocks[:2]]})
+    assert idx.tier_blocks(TIER_G2, 4) == 2
+    idx.apply_event(3, {"kind": "removed", "blocks": [hs[2]]})
+    assert idx.find_matches(hs).worker_blocks[3] == 2
+    idx.apply_event(3, {"kind": "cleared"})
+    assert idx.tier_blocks(TIER_G1, 3) == 0
+    assert idx.tier_blocks(TIER_G2, 4) == 2         # peer tier untouched
+    idx.check_invariants()
